@@ -1,0 +1,163 @@
+"""Orbax-backed checkpointing with auto-resume and key-surgery loading.
+
+TPU-native replacement for the reference's checkpoint stack (SURVEY.md §5):
+full train-state dicts {model, optimizer, lr_scheduler, scaler, epoch,
+max_accuracy} (swin utils/torch_utils.py:233-245 save / :116-141 load),
+auto-resume directory scan (:261-271), rank-0-only writes
+(others/train_with_DDP/train.py:303-308), best-copy
+(classification/mnist/train.py:158-165), and partial/pretrained loading
+with key surgery (others/load_weights_test/load_weights.py, swin
+load_pretrained torch_utils.py:143-231).
+
+Orbax handles multi-host coordination and sharded pytree save/restore, so
+unlike the reference no "rank 0 only" guard is needed around saves.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .logging import create_logger
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints + best tracking + auto-resume."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+                best_fn=None, enable_async_checkpointing=False),
+        )
+        self._logger = create_logger()
+
+    def save(self, step: int, state: Any, metrics: Optional[Dict] = None,
+             is_best: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state),
+                       metrics=metrics)
+        self._mgr.wait_until_finished()
+        if is_best and jax.process_index() == 0:
+            best = os.path.join(self.directory, "best")
+            src = os.path.join(self.directory, str(step))
+            if os.path.isdir(src):
+                if os.path.isdir(best):
+                    shutil.rmtree(best)
+                shutil.copytree(src, best)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of ``state`` (an abstract
+        or concrete pytree)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(state))
+
+    def auto_resume(self, state: Any) -> tuple[Any, int]:
+        """Scan the directory for the newest checkpoint and restore it —
+        the swin auto_resume_helper pattern (torch_utils.py:261-271)."""
+        step = self.latest_step()
+        if step is None:
+            return state, 0
+        self._logger.info(f"auto-resume from step {step} in {self.directory}")
+        return self.restore(state, step), step
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """One-shot save of a pytree (e.g. exported params) without a manager."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_pytree(path: str, target: Optional[Any] = None) -> Any:
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(os.path.abspath(path), target)
+        return ckptr.restore(os.path.abspath(path))
+
+
+def surgical_load(
+    params: Dict[str, Any],
+    pretrained: Dict[str, Any],
+    rename: Optional[Dict[str, str]] = None,
+    drop: Optional[list[str]] = None,
+    resize_fn: Optional[Callable[[str, np.ndarray, tuple], np.ndarray]] = None,
+) -> Dict[str, Any]:
+    """Partial/renamed pretrained loading (load_weights_test pattern).
+
+    Flattens both trees to '/'-joined paths; copies every pretrained leaf
+    whose (renamed) path exists in ``params`` and matches shape. ``drop`` is
+    a list of regexes to skip (e.g. the classifier head when num_classes
+    differs — mnist/train.py:112-117). ``resize_fn(path, value, new_shape)``
+    may adapt mismatched leaves (e.g. position-embedding interpolation, the
+    analog of swin's relative-position-bias interpolation
+    torch_utils.py:143-231); returning None skips the leaf.
+    """
+    flat_params = _flatten(params)
+    flat_pre = _flatten(pretrained)
+    rename = rename or {}
+    drop_res = [re.compile(d) for d in (drop or [])]
+    logger = create_logger()
+    loaded, skipped = 0, []
+    for path, value in flat_pre.items():
+        tgt_path = rename.get(path, path)
+        if any(r.search(tgt_path) for r in drop_res):
+            skipped.append(tgt_path)
+            continue
+        if tgt_path not in flat_params:
+            skipped.append(tgt_path)
+            continue
+        want = flat_params[tgt_path]
+        value = np.asarray(value)
+        if value.shape != want.shape:
+            if resize_fn is not None:
+                value = resize_fn(tgt_path, value, want.shape)
+            if value is None or value.shape != want.shape:
+                skipped.append(tgt_path)
+                continue
+        flat_params[tgt_path] = value.astype(np.asarray(want).dtype)
+        loaded += 1
+    if skipped:
+        logger.info(f"surgical_load: loaded {loaded}, skipped {len(skipped)}: "
+                    f"{skipped[:8]}{'...' if len(skipped) > 8 else ''}")
+    return _unflatten(flat_params)
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, path))
+        else:
+            out[path] = v
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out

@@ -1,0 +1,169 @@
+"""Process-0 logging + metric meters + TensorBoard writer.
+
+TPU-native rework of the reference's logging stack (SURVEY.md §5):
+per-rank colored logger (swin utils/logger.py:9), AverageMeter/ProgressMeter
+(swin utils/torch_utils.py:342,367), SmoothedValue/MetricLogger
+(fasterRcnn utils/distributed_utils.py:12,144), TensorBoard SummaryWriter
+usage across 39 files. Cross-replica metric reduction happens on-device via
+``jax.lax.pmean`` inside jitted steps, so host-side meters stay simple.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import numpy as np
+
+_LOGGERS: Dict[str, logging.Logger] = {}
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def create_logger(name: str = "dltpu", output_dir: Optional[str] = None,
+                  to_console: bool = True) -> logging.Logger:
+    """Formatted logger; console on process 0 only, per-process file logs."""
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.DEBUG)
+    logger.propagate = False
+    fmt = (f"[%(asctime)s p{jax.process_index()}] "
+           "(%(filename)s:%(lineno)d) %(levelname)s: %(message)s")
+    if to_console and is_main_process():
+        h = logging.StreamHandler(sys.stdout)
+        h.setLevel(logging.INFO)
+        h.setFormatter(logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(h)
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
+        fh = logging.FileHandler(
+            os.path.join(output_dir, f"log_p{jax.process_index()}.txt"))
+        fh.setLevel(logging.DEBUG)
+        fh.setFormatter(logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(fh)
+    _LOGGERS[name] = logger
+    return logger
+
+
+class AverageMeter:
+    """Running average over a window plus a global average."""
+
+    def __init__(self, window: int = 50):
+        self._window: deque = deque(maxlen=window)
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, value: float, n: int = 1) -> None:
+        value = float(value)
+        self._window.append(value)
+        self.sum += value * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    @property
+    def smoothed(self) -> float:
+        return float(np.mean(self._window)) if self._window else 0.0
+
+    def reset(self) -> None:
+        self._window.clear()
+        self.sum = 0.0
+        self.count = 0
+
+
+class MetricLogger:
+    """Dict of AverageMeters + iteration timing + ETA, tqdm-free."""
+
+    def __init__(self, delimiter: str = "  ", window: int = 50):
+        self.meters: Dict[str, AverageMeter] = defaultdict(
+            lambda: AverageMeter(window))
+        self.delimiter = delimiter
+
+    def update(self, **kwargs: float) -> None:
+        for k, v in kwargs.items():
+            if hasattr(v, "item"):
+                v = float(v)
+            self.meters[k].update(v)
+
+    def __getattr__(self, name: str) -> AverageMeter:
+        if name in self.meters:
+            return self.meters[name]
+        raise AttributeError(name)
+
+    def __str__(self) -> str:
+        return self.delimiter.join(
+            f"{k}: {m.smoothed:.4f} ({m.avg:.4f})" for k, m in self.meters.items())
+
+    def log_every(self, iterable: Iterable, print_freq: int,
+                  logger: Optional[logging.Logger] = None,
+                  header: str = "") -> Iterable:
+        logger = logger or create_logger()
+        n = len(iterable) if hasattr(iterable, "__len__") else None
+        iter_time = AverageMeter()
+        end = time.time()
+        for i, obj in enumerate(iterable):
+            yield obj
+            iter_time.update(time.time() - end)
+            end = time.time()
+            if i % print_freq == 0 or (n and i == n - 1):
+                eta = ""
+                if n:
+                    eta = f" eta: {iter_time.smoothed * (n - i - 1):.0f}s"
+                logger.info(f"{header} [{i}{'/' + str(n) if n else ''}]"
+                            f" {self}{eta} iter_t: {iter_time.smoothed:.4f}s")
+
+
+class TensorBoardWriter:
+    """Thin process-0-only wrapper over torch's SummaryWriter; no-op elsewhere.
+
+    Covers the reference's TB feature tour (others/tensorboard_test/
+    train.py:77-158): scalars, images, histograms, figures.
+    """
+
+    def __init__(self, log_dir: Optional[str]):
+        self._writer = None
+        if log_dir is not None and is_main_process():
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer = SummaryWriter(log_dir)
+            except ImportError:
+                pass
+
+    def add_scalar(self, tag: str, value: Any, step: int) -> None:
+        if self._writer:
+            self._writer.add_scalar(tag, float(value), step)
+
+    def add_scalars(self, scalars: Dict[str, Any], step: int) -> None:
+        for tag, value in scalars.items():
+            self.add_scalar(tag, value, step)
+
+    def add_image(self, tag: str, img: np.ndarray, step: int,
+                  dataformats: str = "HWC") -> None:
+        if self._writer:
+            self._writer.add_image(tag, img, step, dataformats=dataformats)
+
+    def add_histogram(self, tag: str, values: np.ndarray, step: int) -> None:
+        if self._writer:
+            self._writer.add_histogram(tag, np.asarray(values), step)
+
+    def add_figure(self, tag: str, figure: Any, step: int) -> None:
+        if self._writer:
+            self._writer.add_figure(tag, figure, step)
+
+    def flush(self) -> None:
+        if self._writer:
+            self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer:
+            self._writer.close()

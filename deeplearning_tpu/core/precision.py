@@ -1,0 +1,62 @@
+"""Mixed-precision policy for TPU.
+
+The reference's AMP stack — ``torch.cuda.amp.autocast`` +
+``NativeScalerWithGradNormCount`` (swin utils/torch_utils.py:297-323) —
+exists because fp16 under/overflows. On TPU the compute dtype is bfloat16,
+whose fp32-sized exponent makes loss scaling unnecessary; what we keep from
+the reference scaler is gradient-norm measurement and clipping
+(torch_utils.py:303-318), done here as pure optax-compatible transforms.
+
+Policy: params and optimizer state in float32, activations/matmuls in
+bfloat16 (``dtype=bf16, param_dtype=f32`` on every flax module), gradients
+accumulated in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def get_policy(name: str = "bf16") -> Policy:
+    if name in ("bf16", "bfloat16", "mixed"):
+        return Policy()
+    if name in ("f32", "float32", "full"):
+        return Policy(compute_dtype=jnp.float32)
+    raise ValueError(f"Unknown precision policy {name!r}")
+
+
+def global_norm(tree: Any) -> jax.Array:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in jax.tree.leaves(tree)))
+
+
+def clip_by_global_norm(tree: Any, max_norm: Optional[float]):
+    """Returns (clipped_tree, pre_clip_norm). max_norm None/<=0 disables
+    clipping but still reports the norm (the reference logs grad-norm even
+    when not clipping, swin main.py:196-205)."""
+    norm = global_norm(tree)
+    if not max_norm or max_norm <= 0:
+        return tree, norm
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda x: x * scale.astype(x.dtype), tree), norm

@@ -1,0 +1,35 @@
+"""Deterministic PRNG management.
+
+Replaces the reference's per-project ``torch.manual_seed(seed + rank)``
+idiom (classification/swin_transformer/main.py:321-323) with JAX's explicit
+key threading: one root key per experiment, folded per-host and per-step so
+every jitted step is deterministic and replicable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def host_key(seed: int) -> jax.Array:
+    """Per-host key: distinct data-augmentation streams on each host."""
+    return jax.random.fold_in(jax.random.key(seed), jax.process_index())
+
+
+def step_key(key: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Fold the global step in — makes each train step's dropout/augment
+    stream independent while keeping resume-determinism (the same step
+    replayed after a checkpoint restore sees the same randomness)."""
+    return jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+
+
+def split_named(key: jax.Array, names: Sequence[str]) -> Dict[str, jax.Array]:
+    keys = jax.random.split(key, len(names))
+    return dict(zip(names, keys))

@@ -1,0 +1,1 @@
+from .loader import ArraySource, MapSource, DataLoader, prefetch_to_device  # noqa: F401

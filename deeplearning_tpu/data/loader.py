@@ -1,0 +1,149 @@
+"""Input pipeline: per-host sharded batching with device prefetch.
+
+Replaces the reference's Dataset/DataLoader/DistributedSampler stack
+(SURVEY.md L3; others/train_with_DDP/train.py:140-141, YOLOX
+data_prefetcher.py:8 CUDA-stream prefetch). TPU-first shape: every host
+loads ONLY its slice of the global batch (the DistributedSampler
+successor), batches are fixed-shape (drop_last semantics so jit never
+retraces), and ``prefetch_to_device`` overlaps host→HBM transfer with
+compute — the DataPrefetcher analog without CUDA streams.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel.sharding import make_global_array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ArraySource:
+    """In-memory dataset of parallel arrays (images, labels, ...)."""
+
+    def __init__(self, **arrays: np.ndarray):
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"Array length mismatch: {sizes}")
+        self.arrays = arrays
+        self.size = next(iter(sizes.values()))
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx) -> Dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class MapSource:
+    """Lazy dataset: indices → sample dict via ``fetch`` (the Dataset
+    __getitem__ analog; per-sample decode/augment lives in fetch)."""
+
+    def __init__(self, size: int, fetch: Callable[[int], Dict[str, np.ndarray]]):
+        self.size = size
+        self.fetch = fetch
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx):
+        if isinstance(idx, (int, np.integer)):
+            return self.fetch(int(idx))
+        samples = [self.fetch(int(i)) for i in idx]
+        return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+def epoch_indices(size: int, *, shuffle: bool, seed: int, epoch: int,
+                  drop_last_to: Optional[int] = None) -> np.ndarray:
+    """Deterministic per-epoch permutation — sampler.set_epoch(epoch)
+    becomes seeding by (seed, epoch)."""
+    idx = np.arange(size)
+    if shuffle:
+        idx = np.random.default_rng((seed, epoch)).permutation(size)
+    if drop_last_to:
+        idx = idx[: (size // drop_last_to) * drop_last_to]
+    return idx
+
+
+class DataLoader:
+    """Fixed-shape global batches, host-sharded, optionally device-put.
+
+    - ``global_batch`` is the batch across ALL hosts/devices; each host
+      materializes only its ``global_batch / process_count`` slice.
+    - with a mesh, batches are assembled into global jax.Arrays sharded
+      over the data axes (multi-host DP); without, plain numpy dicts.
+    """
+
+    def __init__(self, source, global_batch: int, *, shuffle: bool = True,
+                 seed: int = 0, mesh: Optional[Mesh] = None,
+                 transform: Optional[Callable[[Dict], Dict]] = None,
+                 infinite: bool = False):
+        self.source = source
+        self.global_batch = global_batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.mesh = mesh
+        self.transform = transform
+        self.infinite = infinite
+        self.epoch = 0
+        n_proc = jax.process_count()
+        if global_batch % n_proc:
+            raise ValueError(f"global_batch {global_batch} not divisible by "
+                             f"process count {n_proc}")
+        self.host_batch = global_batch // n_proc
+
+    def __len__(self) -> int:
+        return len(self.source) // self.global_batch
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _epoch_iter(self, epoch: int) -> Iterator[Dict[str, Any]]:
+        idx = epoch_indices(len(self.source), shuffle=self.shuffle,
+                            seed=self.seed, epoch=epoch,
+                            drop_last_to=self.global_batch)
+        # contiguous host slice of each global batch
+        p = jax.process_index()
+        for start in range(0, len(idx), self.global_batch):
+            gbatch = idx[start:start + self.global_batch]
+            local = gbatch[p * self.host_batch:(p + 1) * self.host_batch]
+            batch = self.source[local]
+            if self.transform:
+                batch = self.transform(batch)
+            if self.mesh is not None:
+                batch = {k: make_global_array(np.asarray(v), self.mesh)
+                         for k, v in batch.items()}
+            yield batch
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        if not self.infinite:
+            yield from self._epoch_iter(self.epoch)
+            return
+        for epoch in itertools.count(self.epoch):
+            yield from self._epoch_iter(epoch)
+
+
+def prefetch_to_device(iterator: Iterator, size: int = 2,
+                       sharding: Optional[NamedSharding] = None) -> Iterator:
+    """Overlap host→device copies with compute (DataPrefetcher analog;
+    flax.jax_utils.prefetch_to_device surface, mesh-sharding aware)."""
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        else:
+            batch = jax.tree.map(jax.device_put, batch)
+        queue.append(batch)
+
+    it = iter(iterator)
+    for b in itertools.islice(it, size):
+        put(b)
+    while queue:
+        yield queue.popleft()
+        for b in itertools.islice(it, 1):
+            put(b)

@@ -1,0 +1,66 @@
+"""Evaluation metrics: top-k accuracy, confusion matrix / mIoU, dice.
+
+Rebuilds the reference's metric helpers as device-side, jit-able reducers:
+top-k accuracy (swin utils/torch_utils.py:325), ConfusionMatrix with mIoU +
+cross-process reduction (Image_segmentation/FCN/utils/distributed_utils.py:
+73-104), dice coefficient (U-Net loss/dice_score.py). Cross-replica
+reduction is free under GSPMD: metrics are SUMS over the global batch, so
+jit over the sharded batch already yields globally-reduced counts (the
+reduce_from_all_processes analog).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def topk_correct(logits: jax.Array, labels: jax.Array,
+                 ks: Sequence[int] = (1, 5)) -> Dict[str, jax.Array]:
+    """Counts (not rates) of top-k correct predictions; divide by the
+    number of examples host-side."""
+    out = {}
+    maxk = min(max(ks), logits.shape[-1])
+    _, pred = jax.lax.top_k(logits, maxk)
+    correct = pred == labels[:, None]
+    for k in ks:
+        k_eff = min(k, maxk)
+        out[f"top{k}"] = jnp.sum(jnp.any(correct[:, :k_eff], axis=-1))
+    out["count"] = jnp.asarray(labels.shape[0], jnp.int32)
+    return out
+
+
+def confusion_matrix(preds: jax.Array, labels: jax.Array,
+                     num_classes: int) -> jax.Array:
+    """(C, C) count matrix, rows=truth, cols=pred; labels<0 or >=C ignored
+    (FCN ConfusionMatrix.update surface)."""
+    valid = (labels >= 0) & (labels < num_classes)
+    idx = labels.astype(jnp.int32) * num_classes + preds.astype(jnp.int32)
+    idx = jnp.where(valid.reshape(idx.shape), idx, num_classes * num_classes)
+    counts = jnp.bincount(idx.reshape(-1),
+                          length=num_classes * num_classes + 1)
+    return counts[:-1].reshape(num_classes, num_classes)
+
+
+def miou_from_confusion(mat: np.ndarray) -> Dict[str, np.ndarray]:
+    """Global accuracy, per-class accuracy and IoU, mean IoU
+    (FCN distributed_utils.py:85-103 compute surface)."""
+    mat = np.asarray(mat, np.float64)
+    diag = np.diag(mat)
+    global_acc = diag.sum() / np.maximum(mat.sum(), 1)
+    class_acc = diag / np.maximum(mat.sum(1), 1)
+    union = mat.sum(1) + mat.sum(0) - diag
+    iou = diag / np.maximum(union, 1)
+    return {"global_acc": global_acc, "class_acc": class_acc,
+            "iou": iou, "miou": iou.mean()}
+
+
+def dice_counts(probs: jax.Array, onehot: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Numerator/denominator sums for a dataset-level dice score."""
+    inter = jnp.sum(probs * onehot)
+    denom = jnp.sum(probs) + jnp.sum(onehot)
+    return 2 * inter, denom

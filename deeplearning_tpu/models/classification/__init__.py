@@ -1,0 +1,1 @@
+from . import lenet, vit  # noqa: F401  (import registers factories)

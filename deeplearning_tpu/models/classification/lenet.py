@@ -1,0 +1,61 @@
+"""MNIST CNN/FCN — the archetype-A reference models.
+
+TPU-native rebuild of classification/mnist/models/network.py (mnist_cnn,
+mnist_fcn): same capacity/API surface, NHWC layout (XLA's preferred conv
+layout on TPU), bf16 compute / f32 params via the dtype policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class MnistFCN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype).reshape(x.shape[0], -1)
+        for width in (512, 256):
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(0.2, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("mnist_cnn")
+def mnist_cnn(num_classes: int = 10, **kw) -> MnistCNN:
+    return MnistCNN(num_classes=num_classes, **kw)
+
+
+@MODELS.register("mnist_fcn")
+def mnist_fcn(num_classes: int = 10, **kw) -> MnistFCN:
+    return MnistFCN(num_classes=num_classes, **kw)

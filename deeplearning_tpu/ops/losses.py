@@ -1,0 +1,204 @@
+"""Loss functions shared across the zoo.
+
+Consolidates the reference's per-project loss code into one module:
+cross-entropy + label smoothing + soft-target CE (swin main.py:111-118,
+TransFG losses/labelSmoothing.py), sigmoid focal loss (RetinaNet
+network_files/losses.py:5-60 — pure-python fvcore port, here vectorized),
+dice (U-Net loss/dice_score.py:5-36), OHEM CE (HR-Net-Seg
+loss/OhemCrossEntropy.py:6), supervised-contrastive (SupCon
+losses/SupConLoss.py:5), triplet + ArcFace (BDB utils/loss.py,
+Happy-Whale retrieval/models/arcFaceloss.py:6), GIoU/IoU losses
+(FCOS models/loss.py:311, YOLOX models/losses.py), smooth-L1
+(fasterRcnn utils/det_utils.py:386), keypoint heatmap MSE
+(Insulator utils/loss.py:6). All take logits/labels with a leading batch
+dim and reduce with an explicit ``weights`` mask so padded/invalid entries
+(the XLA static-shape idiom) drop out of the mean.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _weighted_mean(x: jax.Array, weights: Optional[jax.Array]) -> jax.Array:
+    if weights is None:
+        return jnp.mean(x)
+    weights = weights.astype(x.dtype)
+    return jnp.sum(x * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  label_smoothing: float = 0.0,
+                  weights: Optional[jax.Array] = None) -> jax.Array:
+    """Integer-label CE with optional smoothing; labels < 0 are ignored
+    (the ignore_index idiom of segmentation losses)."""
+    num_classes = logits.shape[-1]
+    valid = labels >= 0
+    labels = jnp.where(valid, labels, 0)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    if label_smoothing > 0:
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / num_classes
+    losses = optax.softmax_cross_entropy(logits, onehot)
+    w = valid.astype(logits.dtype)
+    if weights is not None:
+        w = w * weights.astype(logits.dtype)
+    return _weighted_mean(losses, w)
+
+
+def soft_target_cross_entropy(logits: jax.Array, targets: jax.Array,
+                              weights: Optional[jax.Array] = None) -> jax.Array:
+    """CE against soft targets (mixup path, swin main.py:112)."""
+    losses = optax.softmax_cross_entropy(logits, targets.astype(logits.dtype))
+    return _weighted_mean(losses, weights)
+
+
+def binary_cross_entropy(logits: jax.Array, targets: jax.Array,
+                         weights: Optional[jax.Array] = None,
+                         pos_weight: float = 1.0) -> jax.Array:
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    losses = -(pos_weight * targets * log_p + (1.0 - targets) * log_not_p)
+    return _weighted_mean(losses, weights)
+
+
+def sigmoid_focal_loss(logits: jax.Array, targets: jax.Array,
+                       alpha: float = 0.25, gamma: float = 2.0,
+                       weights: Optional[jax.Array] = None,
+                       reduction: str = "mean") -> jax.Array:
+    """RetinaNet focal loss (network_files/losses.py:5-60 surface)."""
+    p = jax.nn.sigmoid(logits)
+    ce = -(targets * jax.nn.log_sigmoid(logits)
+           + (1 - targets) * jax.nn.log_sigmoid(-logits))
+    p_t = p * targets + (1 - p) * (1 - targets)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        alpha_t = alpha * targets + (1 - alpha) * (1 - targets)
+        loss = alpha_t * loss
+    if reduction == "none":
+        return loss if weights is None else loss * weights
+    if reduction == "sum":
+        return jnp.sum(loss if weights is None else loss * weights)
+    return _weighted_mean(loss, weights)
+
+
+def dice_coefficient(probs: jax.Array, targets: jax.Array,
+                     eps: float = 1e-6, spatial_axes=(-2, -1)) -> jax.Array:
+    """Per-channel dice coefficient (U-Net loss/dice_score.py:5)."""
+    inter = jnp.sum(probs * targets, axis=spatial_axes)
+    denom = jnp.sum(probs, axis=spatial_axes) + jnp.sum(targets, axis=spatial_axes)
+    return jnp.mean((2 * inter + eps) / (denom + eps))
+
+
+def dice_loss(logits: jax.Array, labels: jax.Array,
+              num_classes: Optional[int] = None) -> jax.Array:
+    """Multiclass dice loss over softmax probs (dice_score.py:26-36).
+    logits: (B,H,W,C); labels: (B,H,W) int, <0 ignored."""
+    num_classes = num_classes or logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    valid = (labels >= 0)[..., None]
+    onehot = jax.nn.one_hot(jnp.where(labels >= 0, labels, 0), num_classes,
+                            dtype=logits.dtype) * valid
+    probs = probs * valid
+    return 1.0 - dice_coefficient(
+        jnp.moveaxis(probs, -1, 1), jnp.moveaxis(onehot, -1, 1))
+
+
+def ohem_cross_entropy(logits: jax.Array, labels: jax.Array,
+                       thresh: float = 0.7, min_kept: int = 100000) -> jax.Array:
+    """Online hard-example mining CE (HR-Net-Seg OhemCrossEntropy.py:6):
+    keep pixels whose correct-class prob < thresh, but at least min_kept,
+    expressed as a fixed-shape top-k mask (XLA-safe)."""
+    b = logits.shape[0]
+    num_classes = logits.shape[-1]
+    flat_logits = logits.reshape(-1, num_classes)
+    flat_labels = labels.reshape(-1)
+    valid = flat_labels >= 0
+    safe_labels = jnp.where(valid, flat_labels, 0)
+    probs = jax.nn.softmax(flat_logits, axis=-1)
+    correct_p = jnp.take_along_axis(probs, safe_labels[:, None], axis=-1)[:, 0]
+    correct_p = jnp.where(valid, correct_p, jnp.inf)
+    k = min(min_kept * b, flat_labels.shape[0])
+    kth = jnp.sort(correct_p)[k - 1]
+    threshold = jnp.maximum(kth, thresh)
+    keep = valid & (correct_p <= threshold)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        flat_logits, safe_labels)
+    return _weighted_mean(losses, keep)
+
+
+def smooth_l1(pred: jax.Array, target: jax.Array, beta: float = 1.0 / 9,
+              weights: Optional[jax.Array] = None,
+              reduction: str = "mean") -> jax.Array:
+    """Huber / smooth-L1 (fasterRcnn utils/det_utils.py:386)."""
+    diff = jnp.abs(pred - target)
+    loss = jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
+    if reduction == "none":
+        return loss if weights is None else loss * weights
+    if reduction == "sum":
+        return jnp.sum(loss if weights is None else loss * weights)
+    return _weighted_mean(loss, weights)
+
+
+def supcon_loss(features: jax.Array, labels: jax.Array,
+                temperature: float = 0.07) -> jax.Array:
+    """Supervised contrastive loss (SupCon losses/SupConLoss.py:5).
+    features: (B, V, D) L2-normalized views; labels: (B,)."""
+    b, v, d = features.shape
+    feats = features.reshape(b * v, d)
+    anchor_labels = jnp.repeat(labels, v)
+    sim = feats @ feats.T / temperature
+    # numerical stability
+    sim = sim - jax.lax.stop_gradient(jnp.max(sim, axis=1, keepdims=True))
+    self_mask = 1.0 - jnp.eye(b * v, dtype=sim.dtype)
+    pos_mask = (anchor_labels[:, None] == anchor_labels[None, :]).astype(
+        sim.dtype) * self_mask
+    exp_sim = jnp.exp(sim) * self_mask
+    log_prob = sim - jnp.log(jnp.sum(exp_sim, axis=1, keepdims=True) + 1e-12)
+    mean_log_prob_pos = jnp.sum(pos_mask * log_prob, axis=1) / jnp.maximum(
+        jnp.sum(pos_mask, axis=1), 1.0)
+    return -jnp.mean(mean_log_prob_pos)
+
+
+def triplet_loss(embeddings: jax.Array, labels: jax.Array,
+                 margin: float = 0.3) -> jax.Array:
+    """Batch-hard triplet loss (BDB utils/loss.py TripletLoss surface):
+    hardest positive / hardest negative per anchor within the batch."""
+    dist = jnp.sqrt(jnp.maximum(
+        jnp.sum((embeddings[:, None] - embeddings[None, :]) ** 2, -1), 1e-12))
+    same = labels[:, None] == labels[None, :]
+    eye = jnp.eye(labels.shape[0], dtype=bool)
+    pos_mask = same & ~eye
+    neg_mask = ~same
+    hardest_pos = jnp.max(jnp.where(pos_mask, dist, -jnp.inf), axis=1)
+    hardest_neg = jnp.min(jnp.where(neg_mask, dist, jnp.inf), axis=1)
+    has_both = jnp.any(pos_mask, 1) & jnp.any(neg_mask, 1)
+    loss = jnp.maximum(hardest_pos - hardest_neg + margin, 0.0)
+    return _weighted_mean(loss, has_both)
+
+
+def arcface_logits(embeddings: jax.Array, weight: jax.Array,
+                   labels: jax.Array, s: float = 64.0, m: float = 0.5
+                   ) -> jax.Array:
+    """ArcFace margin logits (Happy-Whale arcFaceloss.py:6: s=64, m=0.5).
+    embeddings: (B,D); weight: (D,C) class centers. Returns scaled logits
+    to feed cross_entropy."""
+    emb = embeddings / (jnp.linalg.norm(embeddings, axis=-1, keepdims=True) + 1e-12)
+    w = weight / (jnp.linalg.norm(weight, axis=0, keepdims=True) + 1e-12)
+    cos = jnp.clip(emb @ w, -1 + 1e-7, 1 - 1e-7)
+    theta = jnp.arccos(cos)
+    target_cos = jnp.cos(theta + m)
+    onehot = jax.nn.one_hot(labels, weight.shape[1], dtype=cos.dtype)
+    return s * (onehot * target_cos + (1 - onehot) * cos)
+
+
+def heatmap_mse_loss(pred: jax.Array, target: jax.Array,
+                     visible: jax.Array) -> jax.Array:
+    """Visibility-weighted keypoint-heatmap MSE (Insulator utils/loss.py:6).
+    pred/target: (B,H,W,K); visible: (B,K) in {0,1,2} — >0 counts."""
+    per_kp = jnp.mean(jnp.square(pred - target), axis=(1, 2))
+    w = (visible > 0).astype(pred.dtype)
+    return _weighted_mean(per_kp, w)

@@ -1,0 +1,6 @@
+from .mesh import (MeshConfig, build_mesh, data_parallel_mesh,  # noqa: F401
+                   initialize_distributed, DATA_AXIS, FSDP_AXIS, SEQ_AXIS,
+                   MODEL_AXIS, EXPERT_AXIS)
+from .sharding import (batch_spec, batch_sharding, replicated,  # noqa: F401
+                       shard_params_tree, make_global_array,
+                       TRANSFORMER_TP_RULES, FSDP_RULES)

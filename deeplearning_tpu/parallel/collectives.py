@@ -1,0 +1,73 @@
+"""Collective helpers over the device mesh.
+
+Maps the reference's torch.distributed usage (SURVEY.md §2.9) onto XLA
+collectives: ``reduce_value`` all-reduce mean
+(others/train_with_DDP/utils/distributed_utils.py:71) → ``pmean``;
+metric ``reduce_dict`` (fasterRcnn utils/distributed_utils.py:116) →
+tree-pmean; SyncBatchNorm (train.py:192) → batch-stat pmean inside the norm
+(see ops/norm.py); object all_gather (YOLOX yolox/utils/dist.py:186) →
+``process_allgather`` on host. Inside pjit-compiled code most collectives
+are implicit — GSPMD inserts them from sharding constraints — so these
+helpers are for shard_map code and for host-side gathers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, FSDP_AXIS
+
+
+def pmean_tree(tree: Any, axis_name: str | tuple = (DATA_AXIS, FSDP_AXIS)) -> Any:
+    """Mean a pytree across replicas — DDP's gradient/metric all-reduce.
+    Only valid inside shard_map/pmap with the axis bound."""
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def psum_tree(tree: Any, axis_name: str | tuple = (DATA_AXIS, FSDP_AXIS)) -> Any:
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def host_allgather(tree: Any) -> Any:
+    """Gather host-local (numpy-backed) pytrees from every process onto all
+    hosts — the analog of torch.distributed all_gather of pickled objects
+    (YOLOX dist.py:186, used for distributed COCO evaluation)."""
+    from jax.experimental import multihost_utils
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jnp.asarray(x)[None], tree)
+    return multihost_utils.process_allgather(tree)
+
+
+def broadcast_from_host0(tree: Any) -> Any:
+    """Rank-0 weight broadcast successor (others/train_with_DDP/
+    train.py:163-177 did this with a tmp file + barrier)."""
+    from jax.experimental import multihost_utils
+    if jax.process_count() == 1:
+        return tree
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def sync_barrier(name: str = "barrier") -> None:
+    from jax.experimental import multihost_utils
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+def with_data_sharding_constraint(x: jax.Array, mesh: Optional[Mesh] = None
+                                  ) -> jax.Array:
+    """Pin the leading dim of an intermediate to the data axes inside jit —
+    the steering wheel for GSPMD when propagation is ambiguous."""
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(
+            mesh or _current_mesh(), P((DATA_AXIS, FSDP_AXIS))))
+
+
+def _current_mesh() -> Mesh:
+    env = jax.sharding.get_abstract_mesh()
+    if env is None:
+        raise RuntimeError("No mesh in scope; pass mesh= explicitly")
+    return env

@@ -1,0 +1,98 @@
+"""Device discovery and mesh construction.
+
+Replaces the reference's L0 device layer — per-project ``select_device`` /
+``.cuda()`` calls (others/train_with_DDP/utils/torch_utils.py:32) — and its
+L2 process-group bootstrap: env-var rank discovery + ``init_process_group
+(nccl|gloo)`` (others/train_with_DDP/train.py:32-111, YOLOX
+yolox/core/launch.py:39-147). In JAX a single ``Mesh`` over all devices plus
+GSPMD subsumes DP/DDP/TP/EP: shard batch over the ``data`` axis (DDP),
+shard params over ``model`` (TP), experts over ``expert`` (EP), sequences
+over ``seq`` (SP/ring attention). XLA inserts the NCCL-equivalent
+collectives over ICI automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, in mesh order. Keeping data outermost puts replicas
+# on the slowest-varying (DCN/ICI-outer) dimension, matching the scaling-book
+# recipe: DP over the outer ring, TP over the densest ICI links (innermost).
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """-1 on the data axis means "absorb all remaining devices"."""
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (the init_process_group analog). On single-host
+    runs this is a no-op; on pods jax.distributed wires the hosts together
+    so jax.devices() is global."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator, num_processes, process_id)
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+
+def build_mesh(cfg: MeshConfig = MeshConfig(),
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = {DATA_AXIS: cfg.data, FSDP_AXIS: cfg.fsdp, SEQ_AXIS: cfg.seq,
+             MODEL_AXIS: cfg.model, EXPERT_AXIS: cfg.expert}
+    fixed = int(np.prod([s for s in sizes.values() if s > 0]))
+    n_infer = sum(1 for s in sizes.values() if s == -1)
+    if n_infer > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if n_infer == 1:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {fixed}")
+        sizes = {k: (n // fixed if s == -1 else s) for k, s in sizes.items()}
+    elif fixed != n:
+        raise ValueError(f"Mesh {sizes} needs {fixed} devices, have {n}")
+    shape = tuple(sizes.values())
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The DDP successor: every device a data replica."""
+    return build_mesh(MeshConfig(), devices)
+
+
+def mesh_shape_str(mesh: Mesh) -> str:
+    return "×".join(f"{k}={v}" for k, v in mesh.shape.items() if v > 1) or "1"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_batch_from_per_device(per_device: int,
+                                 mesh: Optional[Mesh] = None) -> int:
+    """lr/batch scaling helper — the reference scales lr by WORLD_SIZE
+    (others/train_with_DDP/train.py:198); here batch scales by the number
+    of data-parallel shards."""
+    if mesh is None:
+        return per_device * jax.device_count()
+    dp = mesh.shape.get(DATA_AXIS, 1) * mesh.shape.get(FSDP_AXIS, 1)
+    return per_device * dp

@@ -1,0 +1,117 @@
+"""GSPMD sharding rules: batch sharding, parameter sharding, host data split.
+
+This is the TPU-native replacement for DDP + DistributedSampler
+(others/train_with_DDP/train.py:140-195): the batch is sharded over the
+('data','fsdp') mesh axes, parameters are replicated (pure DP) or sharded
+by rule (TP / FSDP), and pjit/GSPMD inserts gradient all-reduces over ICI —
+the analog of DDP's bucketed NCCL all-reduce, but fused by the compiler.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS
+
+
+def batch_spec() -> P:
+    """Shard the leading (batch) dim over data×fsdp; replicate the rest."""
+    return P((DATA_AXIS, FSDP_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding by regex rules (the GSPMD way to express TP/FSDP).
+# A rule maps a '/'-joined param path regex -> PartitionSpec. First match
+# wins; default is replicated.
+# ---------------------------------------------------------------------------
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def logical_to_sharding(mesh: Mesh, rules: Optional[Rules]
+                        ) -> Callable[[str, Any], NamedSharding]:
+    compiled = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def lookup(path: str, leaf: Any) -> NamedSharding:
+        for pat, spec in compiled:
+            if pat.search(path):
+                if len(spec) <= np.ndim(leaf):
+                    return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+    return lookup
+
+
+def tree_paths(tree: Any) -> Any:
+    """Pytree of '/'-joined string paths mirroring ``tree``."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, _ in paths:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        out.append("/".join(parts))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shard_params_tree(params: Any, mesh: Mesh,
+                      rules: Optional[Rules] = None) -> Any:
+    """Pytree of NamedShardings for ``params`` under ``rules``."""
+    lookup = logical_to_sharding(mesh, rules)
+    paths = tree_paths(params)
+    return jax.tree.map(lambda p, x: lookup(p, x), paths, params)
+
+
+# Standard TP rules for transformer blocks (Megatron layout expressed as
+# GSPMD specs — SURVEY.md §2.9 "TP: provide via GSPMD param sharding"):
+# attention qkv + mlp-in column-parallel, proj + mlp-out row-parallel.
+TRANSFORMER_TP_RULES: Rules = (
+    (r"(qkv|query|key|value|mlp/fc1|Dense_0)/kernel$", P(None, MODEL_AXIS)),
+    (r"(proj|out|mlp/fc2|Dense_1)/kernel$", P(MODEL_AXIS, None)),
+    (r"(qkv|query|key|value|mlp/fc1|Dense_0)/bias$", P(MODEL_AXIS)),
+)
+
+# FSDP rules: shard every large matmul kernel's output dim over fsdp.
+FSDP_RULES: Rules = (
+    (r"kernel$", P(None, FSDP_AXIS)),
+)
+
+
+def host_local_slice(global_batch: int) -> Tuple[int, int]:
+    """[start, end) of this host's slice of a global batch — the
+    DistributedSampler successor for per-host data loading."""
+    per_host = global_batch // jax.process_count()
+    start = jax.process_index() * per_host
+    return start, start + per_host
+
+
+def make_global_array(local_batch: np.ndarray, mesh: Mesh,
+                      spec: Optional[P] = None) -> jax.Array:
+    """Assemble per-host local batches into one global sharded jax.Array
+    (multi-host form-up; the reference has no analog because DDP keeps
+    arrays process-local)."""
+    spec = batch_spec() if spec is None else spec
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (local_batch.shape[0] * jax.process_count(),
+                    *local_batch.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local_batch, global_shape)

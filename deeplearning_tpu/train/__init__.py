@@ -1,0 +1,3 @@
+from . import optim, schedules  # noqa: F401  (import registers builders)
+from .state import TrainState  # noqa: F401
+from .steps import make_train_step, make_eval_step, shard_state  # noqa: F401

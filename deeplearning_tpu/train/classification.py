@@ -1,0 +1,56 @@
+"""Classification task wiring: loss_fn + metric_fn for the shared step.
+
+The per-batch logic of every archetype-A/B project's train_one_epoch /
+evaluate pair (classification/mnist/utils.py:30-90, swin main.py:171-278)
+expressed as the two pure functions the jitted steps consume. Supports
+integer labels, label smoothing, and mixup soft targets (swin
+main.py:111-118 criterion selection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..evaluation.metrics import topk_correct
+from ..ops import losses
+from .state import TrainState
+
+
+def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False):
+    def loss_fn(params: Any, state: TrainState, batch: Dict, rng: jax.Array
+                ) -> Tuple[jax.Array, Dict]:
+        variables = state.variables(params)
+        kwargs = dict(train=True, rngs={"dropout": rng})
+        aux: Dict[str, Any] = {}
+        if has_batch_stats:
+            logits, mutated = state.apply_fn(
+                variables, batch["image"], mutable=["batch_stats"], **kwargs)
+            aux["batch_stats"] = mutated["batch_stats"]
+        else:
+            logits = state.apply_fn(variables, batch["image"], **kwargs)
+        labels = batch["label"]
+        if labels.ndim == logits.ndim:          # mixup soft targets
+            loss = losses.soft_target_cross_entropy(logits, labels)
+            acc_labels = jnp.argmax(labels, -1)
+        else:
+            loss = losses.cross_entropy(logits, labels, label_smoothing)
+            acc_labels = labels
+        acc = jnp.mean((jnp.argmax(logits, -1) == acc_labels).astype(
+            jnp.float32))
+        aux["metrics"] = {"accuracy": acc}
+        return loss, aux
+    return loss_fn
+
+
+def make_metric_fn(ks=(1, 5)):
+    def metric_fn(params: Any, state: TrainState, batch: Dict) -> Dict:
+        logits = state.apply_fn(state.variables(params), batch["image"],
+                                train=False)
+        counts = topk_correct(logits, batch["label"], ks)
+        counts["loss_sum"] = losses.cross_entropy(
+            logits, batch["label"]) * batch["label"].shape[0]
+        return counts
+    return metric_fn

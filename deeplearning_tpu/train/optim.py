@@ -1,0 +1,85 @@
+"""Optimizer builders with parameter-group filtering + LARS.
+
+Covers the reference's optimizer surface: SGD/Adam/AdamW with weight-decay
+exclusion of norm/bias/special params (swin utils/optimizer.py:11-58
+set_weight_decay keywords; yolov5 train.py three param groups), and the
+LARS/LARC wrapper used for MAE pretrain (self-supervised/MAE/utils/
+LARS.py:6). All expressed as optax chains with masks, so they compose with
+any schedule and with gradient clipping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import optax
+
+from ..core.registry import OPTIMIZERS
+from ..parallel.sharding import tree_paths
+
+NO_DECAY_PATTERNS = ("bias", "scale", "norm", "bn", "pos_embed", "cls_token",
+                     "relative_position_bias", "absolute_pos_embed", "logit_scale")
+
+
+def decay_mask(params: Any,
+               no_decay: Sequence[str] = NO_DECAY_PATTERNS) -> Any:
+    """True where weight decay applies: 2D+ kernels, excluding listed names.
+    1D params (biases, norm scales) never decay — matches the reference's
+    keyword skip-list (swin optimizer.py:42-58)."""
+    paths = tree_paths(params)
+
+    def keep(path: str, leaf: Any) -> bool:
+        lp = path.lower()
+        if any(p in lp for p in no_decay):
+            return False
+        import numpy as np
+        return np.ndim(leaf) >= 2
+    return jax.tree.map(keep, paths, params)
+
+
+@OPTIMIZERS.register("sgd")
+def sgd(schedule, momentum: float = 0.9, nesterov: bool = False,
+        weight_decay: float = 0.0, params: Any = None, **_):
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(
+            weight_decay, mask=decay_mask(params) if params is not None else None))
+    chain.append(optax.sgd(schedule, momentum=momentum, nesterov=nesterov))
+    return optax.chain(*chain)
+
+
+@OPTIMIZERS.register("adam")
+def adam(schedule, b1: float = 0.9, b2: float = 0.999, **_):
+    return optax.adam(schedule, b1=b1, b2=b2)
+
+
+@OPTIMIZERS.register("adamw")
+def adamw(schedule, b1: float = 0.9, b2: float = 0.999,
+          weight_decay: float = 0.05, eps: float = 1e-8,
+          params: Any = None, **_):
+    return optax.adamw(
+        schedule, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+        mask=decay_mask(params) if params is not None else None)
+
+
+@OPTIMIZERS.register("lars")
+def lars(schedule, momentum: float = 0.9, weight_decay: float = 0.0,
+         trust_coefficient: float = 0.001, params: Any = None, **_):
+    """LARS for large-batch SSL pretrain (MAE utils/LARS.py:6 LARC port —
+    optax.lars implements the same layer-wise trust ratio)."""
+    return optax.lars(
+        schedule, weight_decay=weight_decay,
+        weight_decay_mask=decay_mask(params) if params is not None else True,
+        trust_coefficient=trust_coefficient, momentum=momentum)
+
+
+def build_optimizer(name: str, schedule, clip_grad_norm: Optional[float] = None,
+                    params: Any = None, **kwargs) -> optax.GradientTransformation:
+    """Optimizer chain with optional global-norm clipping in front (the
+    reference clips before step inside its AMP scaler,
+    swin utils/torch_utils.py:303-318)."""
+    tx = OPTIMIZERS.build(name, schedule, params=params, **kwargs)
+    if clip_grad_norm and clip_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(clip_grad_norm), tx)
+    return tx

@@ -1,0 +1,101 @@
+"""Per-iteration LR schedules.
+
+Port surface (not code) of the reference's schedulers: cosine LambdaLR with
+warmup (classification/mnist/train.py:130-137), timm-style warmup-cosine
+stepped per iteration (swin utils/lr_scheduler.py:7), YOLOX "yoloxwarmcos"
+with quadratic warmup + no-aug floor (yolox/utils/lr_scheduler.py), poly
+schedule with warmup for segmentation (FCN utils/train_and_eval.py:65),
+multi-step decay. All are optax schedules (step -> lr), jit-safe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import optax
+
+from ..core.registry import SCHEDULES
+
+
+@SCHEDULES.register("constant")
+def constant(base_lr: float, total_steps: int = 0, **_) -> optax.Schedule:
+    return optax.constant_schedule(base_lr)
+
+
+@SCHEDULES.register("warmup_cosine")
+def warmup_cosine(base_lr: float, total_steps: int,
+                  warmup_steps: int = 0, warmup_lr: float = 1e-7,
+                  min_lr: float = 0.0, **_) -> optax.Schedule:
+    """Linear warmup then cosine to min_lr (swin lr_scheduler.py:7)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=warmup_lr, peak_value=base_lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=min_lr)
+
+
+@SCHEDULES.register("cosine_lambda")
+def cosine_lambda(base_lr: float, total_steps: int, lrf: float = 0.1,
+                  **_) -> optax.Schedule:
+    """The archetype-A cosine LambdaLR: lr(t) = base*((1+cos(pi t/T))/2*(1-lrf)+lrf)
+    (classification/mnist/train.py:133-137)."""
+    def sched(step):
+        t = optax.cosine_decay_schedule(1.0, max(total_steps, 1))(step)
+        # cosine_decay returns (1+cos)/2 shape already via alpha=0
+        return base_lr * (t * (1 - lrf) + lrf)
+    return sched
+
+
+@SCHEDULES.register("yolox_warmcos")
+def yolox_warmcos(base_lr: float, total_steps: int, warmup_steps: int = 0,
+                  warmup_lr_start: float = 0.0, min_lr_ratio: float = 0.05,
+                  no_aug_steps: int = 0, **_) -> optax.Schedule:
+    """Quadratic warmup -> cosine -> flat floor during no-aug epochs
+    (YOLOX yolox/utils/lr_scheduler.py)."""
+    min_lr = base_lr * min_lr_ratio
+
+    def sched(step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, jnp.float32)
+        warm = (base_lr - warmup_lr_start) * jnp.square(
+            step / max(warmup_steps, 1)) + warmup_lr_start
+        main_span = max(total_steps - warmup_steps - no_aug_steps, 1)
+        cos = min_lr + 0.5 * (base_lr - min_lr) * (
+            1.0 + jnp.cos(math.pi * (step - warmup_steps) / main_span))
+        lr = jnp.where(step < warmup_steps, warm,
+                       jnp.where(step >= total_steps - no_aug_steps,
+                                 min_lr, cos))
+        return lr
+    return sched
+
+
+@SCHEDULES.register("poly")
+def poly(base_lr: float, total_steps: int, warmup_steps: int = 0,
+         power: float = 0.9, warmup_factor: float = 1e-3, **_) -> optax.Schedule:
+    """Poly decay with linear warmup (FCN utils/train_and_eval.py:65)."""
+    def sched(step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, jnp.float32)
+        alpha = step / max(warmup_steps, 1)
+        warm = base_lr * (warmup_factor * (1 - alpha) + alpha)
+        frac = 1.0 - (step - warmup_steps) / max(total_steps - warmup_steps, 1)
+        main = base_lr * jnp.power(jnp.clip(frac, 0.0, 1.0), power)
+        return jnp.where(step < warmup_steps, warm, main)
+    return sched
+
+
+@SCHEDULES.register("multistep")
+def multistep(base_lr: float, milestones: Sequence[int] = (),
+              gamma: float = 0.1, warmup_steps: int = 0, **_) -> optax.Schedule:
+    sched = optax.piecewise_constant_schedule(
+        base_lr, {int(m): gamma for m in milestones})
+    if warmup_steps:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, base_lr, warmup_steps), sched],
+            [warmup_steps])
+    return sched
+
+
+def build_schedule(name: str, **kwargs) -> optax.Schedule:
+    return SCHEDULES.build(name, **kwargs)

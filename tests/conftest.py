@@ -1,0 +1,20 @@
+"""Test env: 8 virtual CPU devices so mesh/pjit/collective paths run in CI
+without a pod (SURVEY.md §4 rebuild strategy (b)).
+
+Note: this image's sitecustomize imports jax at interpreter start (axon TPU
+tunnel), so JAX_PLATFORMS in os.environ is read too early to help — the
+platform must be forced via jax.config, and the host-device-count flag via
+XLA_FLAGS before backend initialization (which register() does not do).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

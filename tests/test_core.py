@@ -1,0 +1,147 @@
+"""Core runtime tests: config, registry, precision, rng, checkpoint."""
+
+import dataclasses
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core import config as cfg_mod
+from deeplearning_tpu.core import precision, rng
+from deeplearning_tpu.core.checkpoint import (CheckpointManager, load_pytree,
+                                              save_pytree, surgical_load)
+from deeplearning_tpu.core.registry import Registry
+
+
+@dataclasses.dataclass(frozen=True)
+class Train:
+    lr: float = 0.1
+    epochs: int = 10
+    sizes: Tuple[int, ...] = (1, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cfg:
+    name: str = "m"
+    train: Train = dataclasses.field(default_factory=Train)
+
+
+class TestConfig:
+    def test_defaults_yaml_cli_precedence(self, tmp_path):
+        base = tmp_path / "base.yaml"
+        base.write_text("train:\n  lr: 0.5\n  epochs: 3\n")
+        child = tmp_path / "child.yaml"
+        child.write_text(f"_base_: base.yaml\nname: x\ntrain:\n  lr: 0.7\n")
+        out = cfg_mod.load_config(Cfg(), str(child),
+                                  opts=["train.epochs", "99"])
+        assert out.name == "x"
+        assert out.train.lr == 0.7          # yaml beats base
+        assert out.train.epochs == 99       # cli beats yaml
+
+    def test_equals_style_opts_and_coercion(self):
+        out = cfg_mod.load_config(Cfg(), opts=["train.lr=1e-3",
+                                               "train.sizes=[4,5,6]"])
+        assert out.train.lr == pytest.approx(1e-3)
+        assert out.train.sizes == (4, 5, 6)
+
+    def test_strict_unknown_key(self):
+        with pytest.raises(KeyError):
+            cfg_mod.load_config(Cfg(), opts=["nope", "1"])
+
+    def test_save_roundtrip(self, tmp_path):
+        p = str(tmp_path / "c.yaml")
+        cfg_mod.save_config(Cfg(), p)
+        out = cfg_mod.load_config(Cfg(), p)
+        assert out == Cfg()
+
+
+class TestRegistry:
+    def test_register_get_build(self):
+        reg = Registry("t")
+
+        @reg.register()
+        def thing(x):
+            return x * 2
+
+        assert reg.build("thing", 3) == 6
+        with pytest.raises(KeyError):
+            reg.get("missing")
+        with pytest.raises(KeyError):
+            reg.register("thing")(lambda: None)
+
+
+class TestPrecision:
+    def test_policy_cast(self):
+        pol = precision.get_policy("bf16")
+        tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+        out = pol.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+
+    def test_clip_by_global_norm(self):
+        tree = {"a": jnp.ones((3,)) * 2.0}
+        clipped, norm = precision.clip_by_global_norm(tree, 1.0)
+        assert norm == pytest.approx(np.sqrt(12), rel=1e-5)
+        got = precision.global_norm(clipped)
+        assert float(got) == pytest.approx(1.0, rel=1e-4)
+
+    def test_no_clip_reports_norm(self):
+        tree = {"a": jnp.ones((4,))}
+        same, norm = precision.clip_by_global_norm(tree, None)
+        assert float(norm) == pytest.approx(2.0)
+        np.testing.assert_array_equal(same["a"], tree["a"])
+
+
+class TestRng:
+    def test_step_key_deterministic(self):
+        k = rng.root_key(0)
+        a = jax.random.normal(rng.step_key(k, 5), (3,))
+        b = jax.random.normal(rng.step_key(k, 5), (3,))
+        c = jax.random.normal(rng.step_key(k, 6), (3,))
+        np.testing.assert_array_equal(a, b)
+        assert not np.allclose(a, c)
+
+
+class TestCheckpoint:
+    def test_manager_save_restore_auto_resume(self, tmp_path):
+        state = {"params": {"w": jnp.arange(4.0)}, "step": jnp.asarray(0)}
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        mgr.save(1, state)
+        state2 = {"params": {"w": jnp.arange(4.0) * 2},
+                  "step": jnp.asarray(1)}
+        mgr.save(2, state2, is_best=True)
+        restored, step = mgr.auto_resume(jax.tree.map(np.zeros_like, state))
+        assert step == 2
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      np.arange(4.0) * 2)
+        assert os.path.isdir(str(tmp_path / "ckpt" / "best"))
+        mgr.close()
+
+    def test_pytree_roundtrip(self, tmp_path):
+        tree = {"a": np.ones((2, 3)), "b": {"c": np.arange(5)}}
+        save_pytree(str(tmp_path / "tree"), tree)
+        out = load_pytree(str(tmp_path / "tree"))
+        np.testing.assert_array_equal(out["a"], tree["a"])
+        np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
+
+    def test_surgical_load(self):
+        params = {"backbone": {"w": np.zeros((3, 3))},
+                  "head": {"w": np.zeros((3, 10))}}
+        pretrained = {"backbone": {"w": np.ones((3, 3))},
+                      "head": {"w": np.ones((3, 5))}}   # mismatched head
+        out = surgical_load(params, pretrained, drop=[r"^head"])
+        np.testing.assert_array_equal(out["backbone"]["w"], np.ones((3, 3)))
+        np.testing.assert_array_equal(out["head"]["w"], np.zeros((3, 10)))
+
+    def test_surgical_load_resize_hook(self):
+        params = {"pos": np.zeros((4,))}
+        pretrained = {"pos": np.ones((2,))}
+
+        def resize(path, value, shape):
+            return np.resize(value, shape)
+
+        out = surgical_load(params, pretrained, resize_fn=resize)
+        np.testing.assert_array_equal(out["pos"], np.ones((4,)))
