@@ -1,1 +1,1 @@
-from . import lenet, vit  # noqa: F401  (import registers factories)
+from . import lenet, swin, vit  # noqa: F401  (import registers factories)

@@ -1,0 +1,287 @@
+"""Swin Transformer v1/v2 — hierarchical windowed attention.
+
+Capability surface of classification/swin_transformer/models/
+swin_transformer.py: WindowAttention with relative position bias (:70),
+SwinTransformerBlock with cyclic shift + mask (:168), PatchMerging (:308),
+BasicLayer, SwinTransformer (:410-411 gradient checkpointing), and the
+v2 variants (swin_transformer_v2.py: cosine attention with learned
+logit scale, log-spaced continuous position bias MLP).
+
+TPU-first: windows are processed as one batched matmul over
+(windows × heads); the fused Pallas kernel (ops/pallas/window_attention.py)
+replaces the reference's CUDA roll+partition kernel; roll/partition
+themselves are lax ops XLA fuses. NHWC throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ...ops import window_utils as wu
+from .vit import DropPath, Mlp
+
+
+class WindowAttention(nn.Module):
+    """Window MHSA with relative position bias (v1) or cosine attention
+    with log-CPB (v2)."""
+    dim: int
+    window: int
+    num_heads: int
+    qkv_bias: bool = True
+    v2: bool = False
+    dtype: Any = jnp.bfloat16
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None,
+                 deterministic: bool = True):
+        bw, n, c = x.shape
+        d = c // self.num_heads
+        if self.v2 and self.use_pallas:
+            raise NotImplementedError(
+                "Pallas fused window attention supports the v1 "
+                "(bias-table) path only; cosine attention runs unfused.")
+        if self.v2 and self.qkv_bias:
+            # v2 uses q/v biases only: a k bias is NOT softmax-invariant
+            # under cosine attention (it shifts keys before normalization).
+            qkv = nn.Dense(3 * c, use_bias=False, dtype=self.dtype,
+                           name="qkv")(x)
+            q_bias = self.param("q_bias", nn.initializers.zeros, (c,),
+                                jnp.float32)
+            v_bias = self.param("v_bias", nn.initializers.zeros, (c,),
+                                jnp.float32)
+            bias_vec = jnp.concatenate(
+                [q_bias, jnp.zeros_like(q_bias), v_bias])
+            qkv = qkv + bias_vec.astype(qkv.dtype)
+        else:
+            qkv = nn.Dense(3 * c, use_bias=self.qkv_bias, dtype=self.dtype,
+                           name="qkv")(x)
+        qkv = qkv.reshape(bw, n, 3, self.num_heads, d)
+
+        if self.v2:
+            # swin v2: cosine attention + continuous position bias MLP over
+            # log-spaced coords (swin_transformer_v2.py surface).
+            logit_scale = self.param(
+                "logit_scale",
+                lambda key, shape: jnp.log(10.0) * jnp.ones(shape),
+                (self.num_heads, 1, 1))
+            rel_coords = wu.relative_position_index(self.window)
+            coords_table = self._log_coords_table()
+            cpb = nn.Sequential([
+                nn.Dense(512, dtype=jnp.float32, name="cpb_fc1"),
+                nn.relu,
+                nn.Dense(self.num_heads, use_bias=False, dtype=jnp.float32,
+                         name="cpb_fc2")])(coords_table)
+            bias = 16.0 * nn.sigmoid(cpb[rel_coords.reshape(-1)])
+            bias = bias.reshape(n, n, self.num_heads).transpose(2, 0, 1)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            qn = q / (jnp.linalg.norm(q.astype(jnp.float32), axis=-1,
+                                      keepdims=True) + 1e-6)
+            kn = k / (jnp.linalg.norm(k.astype(jnp.float32), axis=-1,
+                                      keepdims=True) + 1e-6)
+            scale = jnp.exp(jnp.minimum(logit_scale, jnp.log(100.0)))
+            s = jnp.einsum("bqhd,bkhd->bhqk", qn, kn).astype(jnp.float32)
+            s = s * scale[None] + bias[None]
+            if mask is not None:
+                nw = mask.shape[0]
+                s = s.reshape(bw // nw, nw, self.num_heads, n, n) \
+                    + mask[None, :, None]
+                s = s.reshape(bw, self.num_heads, n, n)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(bw, n, c)
+        else:
+            table = self.param(
+                "relative_position_bias_table",
+                nn.initializers.truncated_normal(0.02),
+                ((2 * self.window - 1) ** 2, self.num_heads), jnp.float32)
+            idx = wu.relative_position_index(self.window)
+            bias = table[idx.reshape(-1)].reshape(n, n, self.num_heads)
+            bias = bias.transpose(2, 0, 1)          # (heads, N, N)
+            if self.use_pallas:
+                from ...ops.pallas.window_attention import (
+                    window_attention_checkpointed)
+                out = window_attention_checkpointed(qkv, bias, mask)
+            else:
+                out = wu.windowed_attention_reference(qkv, bias, mask)
+
+        out = nn.Dense(c, dtype=self.dtype, name="proj")(out)
+        return out
+
+    def _log_coords_table(self):
+        w = self.window
+        rel = np.arange(-(w - 1), w, dtype=np.float32)
+        table = np.stack(np.meshgrid(rel, rel, indexing="ij"),
+                         axis=-1).reshape(-1, 2)
+        table = table / (w - 1) * 8
+        table = np.sign(table) * np.log2(np.abs(table) + 1.0) / np.log2(8)
+        return jnp.asarray(table)
+
+
+class SwinBlock(nn.Module):
+    dim: int
+    input_resolution: Tuple[int, int]
+    num_heads: int
+    window: int = 7
+    shift: int = 0
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = True
+    drop: float = 0.0
+    drop_path_rate: float = 0.0
+    v2: bool = False
+    dtype: Any = jnp.bfloat16
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h, w = self.input_resolution
+        b, n, c = x.shape
+        window = min(self.window, h, w)
+        shift = 0 if window >= min(h, w) else self.shift
+
+        shortcut = x
+        if not self.v2:                      # v1: pre-norm
+            x = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        x = x.reshape(b, h, w, c)
+        if shift > 0:
+            x = jnp.roll(x, (-shift, -shift), axis=(1, 2))
+            mask = jnp.asarray(wu.shift_window_mask(h, w, window, shift))
+        else:
+            mask = None
+        wins = wu.window_partition(x, window)          # (B*nW, win², C)
+        wins = WindowAttention(self.dim, window, self.num_heads,
+                               self.qkv_bias, self.v2, self.dtype,
+                               self.use_pallas, name="attn")(
+            wins, mask, deterministic)
+        x = wu.window_merge(wins, window, h, w)
+        if shift > 0:
+            x = jnp.roll(x, (shift, shift), axis=(1, 2))
+        x = x.reshape(b, n, c)
+        if self.v2:                          # v2: post-norm (res-post-norm)
+            x = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        x = shortcut + DropPath(self.drop_path_rate)(x, deterministic)
+
+        y = x
+        if not self.v2:
+            y = nn.LayerNorm(dtype=self.dtype, name="norm2")(y)
+        y = Mlp(self.mlp_ratio, self.drop, self.dtype, name="mlp")(
+            y, deterministic)
+        if self.v2:
+            y = nn.LayerNorm(dtype=self.dtype, name="norm2")(y)
+        return x + DropPath(self.drop_path_rate)(y, deterministic)
+
+
+class PatchMerging(nn.Module):
+    """2×2 patch merge + channel double (swin_transformer.py:308). v2 moves
+    the norm AFTER the reduction (res-post-norm, over 2C not 4C)."""
+    input_resolution: Tuple[int, int]
+    dtype: Any = jnp.bfloat16
+    v2: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        h, w = self.input_resolution
+        b, n, c = x.shape
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // 2) * (w // 2),
+                                                  4 * c)
+        if self.v2:
+            x = nn.Dense(2 * c, use_bias=False, dtype=self.dtype,
+                         name="reduction")(x)
+            return nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        return nn.Dense(2 * c, use_bias=False, dtype=self.dtype,
+                        name="reduction")(x)
+
+
+class SwinTransformer(nn.Module):
+    img_size: int = 224
+    patch_size: int = 4
+    num_classes: int = 1000
+    embed_dim: int = 96
+    depths: Sequence[int] = (2, 2, 6, 2)
+    num_heads: Sequence[int] = (3, 6, 12, 24)
+    window: int = 7
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = True
+    drop_rate: float = 0.0
+    drop_path_rate: float = 0.1
+    v2: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        deterministic = not train
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.embed_dim, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        x = nn.LayerNorm(dtype=self.dtype, name="patch_norm")(x)
+        x = nn.Dropout(self.drop_rate, deterministic=deterministic)(x)
+
+        total_depth = sum(self.depths)
+        dpr = np.linspace(0, self.drop_path_rate, total_depth)
+        block_idx = 0
+        res = (h, w)
+        dim = self.embed_dim
+        for stage, (depth, heads) in enumerate(zip(self.depths,
+                                                   self.num_heads)):
+            for i in range(depth):
+                blk = SwinBlock
+                if self.remat:
+                    blk = nn.remat(SwinBlock, static_argnums=(2,))
+                x = blk(dim, res, heads, self.window,
+                        0 if i % 2 == 0 else self.window // 2,
+                        self.mlp_ratio, self.qkv_bias, self.drop_rate,
+                        float(dpr[block_idx]), self.v2, self.dtype,
+                        self.use_pallas,
+                        name=f"stage{stage}_block{i}")(x, deterministic)
+                block_idx += 1
+            if stage < len(self.depths) - 1:
+                x = PatchMerging(res, self.dtype, self.v2,
+                                 name=f"stage{stage}_merge")(x)
+                res = (res[0] // 2, res[1] // 2)
+                dim *= 2
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head",
+                     kernel_init=nn.initializers.zeros)(x)
+        return x.astype(jnp.float32)
+
+
+def _factory(name, **defaults):
+    @MODELS.register(name)
+    def build(num_classes: int = 1000, **kw):
+        return SwinTransformer(**{**defaults, "num_classes": num_classes,
+                                  **kw})
+    build.__name__ = name
+    return build
+
+
+swin_tiny_patch4_window7_224 = _factory(
+    "swin_tiny_patch4_window7_224", embed_dim=96, depths=(2, 2, 6, 2),
+    num_heads=(3, 6, 12, 24))
+swin_small_patch4_window7_224 = _factory(
+    "swin_small_patch4_window7_224", embed_dim=96, depths=(2, 2, 18, 2),
+    num_heads=(3, 6, 12, 24))
+swin_base_patch4_window7_224 = _factory(
+    "swin_base_patch4_window7_224", embed_dim=128, depths=(2, 2, 18, 2),
+    num_heads=(4, 8, 16, 32))
+swin_large_patch4_window7_224 = _factory(
+    "swin_large_patch4_window7_224", embed_dim=192, depths=(2, 2, 18, 2),
+    num_heads=(6, 12, 24, 48))
+swinv2_tiny_patch4_window7_224 = _factory(
+    "swinv2_tiny_patch4_window7_224", embed_dim=96, depths=(2, 2, 6, 2),
+    num_heads=(3, 6, 12, 24), v2=True)
+swinv2_base_patch4_window7_224 = _factory(
+    "swinv2_base_patch4_window7_224", embed_dim=128, depths=(2, 2, 18, 2),
+    num_heads=(4, 8, 16, 32), v2=True)

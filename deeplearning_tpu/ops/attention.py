@@ -1,0 +1,37 @@
+"""Attention dispatch: naive lax path vs Pallas flash kernel.
+
+``get_attn_fn("flash")`` plugs into models' ``attn_fn`` slot
+(models/classification/vit.py Attention). The naive path is the golden
+reference; the flash path is the TPU production path. Attention dropout is
+applied on the naive path only — flash attention ignores it (attn-dropout
+is 0 in all reference training configs; ViT uses drop_path instead).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from .pallas.flash_attention import flash_attention_bnhd
+
+
+def flash_attn_adapter(q, k, v, dropout_rate: float = 0.0,
+                       deterministic: bool = True,
+                       rng: Optional[jax.Array] = None):
+    """(B, N, H, D) adapter matching models' attn_fn signature."""
+    if dropout_rate > 0.0 and not deterministic:
+        raise NotImplementedError(
+            "flash attention does not implement attention dropout; set "
+            "attn_drop_rate=0 (use drop_path for regularization) or use "
+            "the naive attention path.")
+    del rng
+    return flash_attention_bnhd(q, k, v)
+
+
+def get_attn_fn(name: str = "flash") -> Optional[Callable]:
+    if name in ("flash", "pallas"):
+        return flash_attn_adapter
+    if name in ("naive", "lax", "reference"):
+        return None  # models fall back to their built-in naive path
+    raise ValueError(f"Unknown attention implementation {name!r}")

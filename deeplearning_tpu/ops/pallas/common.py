@@ -1,0 +1,11 @@
+"""Shared Pallas helpers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """True when kernels must run in interpret mode (CPU backend — used by
+    the virtual-device test mesh and multi-chip dry-runs)."""
+    return jax.default_backend() == "cpu"

@@ -1,0 +1,303 @@
+"""Flash attention for TPU: fused online-softmax attention in Pallas.
+
+This is the framework's answer to the reference's attention hot paths —
+the naive materialized softmax in ViT (classification/vision_transformer/
+vit_model.py:100-111) and the CUDA window kernel motivation in Swin
+(SURVEY.md §2.10.1): never materialize the (N, N) attention matrix in HBM.
+Forward and backward are Pallas kernels with a custom VJP; the backward
+recomputes P = exp(S - LSE) blockwise from the saved logsumexp, FlashAttention-2
+style.
+
+Also the building block for ring attention (parallel/ring_attention.py):
+the kernel exposes running (out, lse) so per-device KV chunks can be
+combined across the ``seq`` mesh axis.
+
+Layout: (B, H, N, D). N must be a multiple of the block size — wrappers
+pad and mask via ``kv_len`` (the number of valid key tokens).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import interpret_mode
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale: float, block_k: int, kv_len: int, causal: bool,
+                q_block: int):
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, n, d); o_ref like q_ref;
+    # lse_ref: (1, block_q, 8) — 8-lane padded, lane 0 meaningful.
+    qi = pl.program_id(1)
+    q = q_ref[0]  # native dtype (bf16 in production) -> MXU full rate
+    n = k_ref.shape[1]
+    nk = n // block_k
+
+    def body(ki, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    bq, d = q.shape
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l_safe)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (lse.shape[0], 8))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sm_scale: float, block_k: int, kv_len: int, causal: bool,
+                   q_block: int):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    n = k_ref.shape[1]
+    nk = n // block_k
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 0)
+            mask = mask & (col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq = dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dq
+
+    dq = jax.lax.fori_loop(0, nk, body,
+                           jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale: float, block_q: int,
+                    kv_len: int, causal: bool, k_block: int):
+    ki = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    n = q_ref.shape[1]
+    nq = n // block_q
+    col = ki * k_block + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, k.shape[0]), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, k.shape[0]), 0)
+            mask = mask & (col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flatten_bh(x):
+    b, h, n, d = x.shape
+    return x.reshape(b * h, n, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, kv_len, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, sm_scale, kv_len, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, kv_len, causal, block_q, block_k):
+    b, h, n, d = q.shape
+    qf, kf, vf = map(_flatten_bh, (q, k, v))
+    grid = (b * h, n // block_q)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               block_k=block_k, kv_len=kv_len, causal=causal,
+                               q_block=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, n, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, n, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, n, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, n, 8), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(qf, kf, vf)
+    out = out.reshape(b, h, n, d)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, kv_len, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    b, h, n, d = q.shape
+    qf, kf, vf = map(_flatten_bh, (q, k, v))
+    dof = _flatten_bh(dout)
+    of = _flatten_bh(out)
+    # delta_i = rowsum(dO_i * O_i); stored (bh, n, 8) like lse
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (b * h, n, 8))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k,
+                          kv_len=kv_len, causal=causal, q_block=block_q),
+        grid=(b * h, n // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, n, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, n, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, n, d), q.dtype),
+        interpret=interpret_mode(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          block_q=block_q, kv_len=kv_len, causal=causal,
+                          k_block=block_k),
+        grid=(b * h, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, n, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, n, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, n, 8), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, n, 8), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, n, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, n, d), v.dtype),
+        ],
+        interpret=interpret_mode(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = dq.reshape(b, h, n, d)
+    dk = dk.reshape(b, h, n, d)
+    dv = dv.reshape(b, h, n, d)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    sm_scale: Optional[float] = None,
+                    causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Fused attention. q,k,v: (B, H, N, D) with any N — padded internally
+    to a block multiple; padded KEY positions are masked out and padded
+    QUERY rows are dropped on return. D should be 64/128 for best MXU use.
+    """
+    b, h, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    block_q = min(block_q, _round_block(n))
+    block_k = min(block_k, _round_block(n))
+    n_pad = -n % math.lcm(block_q, block_k)
+    if n_pad:
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    out = _flash(q, k, v, sm_scale, n, causal, block_q, block_k)
+    return out[:, :, :n, :]
+
+
+def _round_block(n: int) -> int:
+    """Largest power-of-two block <= max(n, 128) capped at 128, >=8."""
+    b = 128
+    while b > 8 and b > n:
+        b //= 2
+    return max(b, 8)
+
+
+def flash_attention_bnhd(q: jax.Array, k: jax.Array, v: jax.Array,
+                         **kw) -> jax.Array:
+    """(B, N, H, D) layout convenience wrapper (the models' layout)."""
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), **kw)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,138 @@
+"""Fused window attention — the Swin CUDA kernel's TPU-era successor.
+
+The reference hand-fuses roll+partition in CUDA (classification/
+swin_transformer/kernels/window_process/swin_window_process_kernel.cu:41-64)
+because torch dispatches each of roll/view/permute as a separate kernel. On
+TPU, XLA already fuses those copies; what XLA does NOT do is keep the
+per-window attention matrix out of HBM. So the Pallas kernel here fuses the
+ATTENTION: for a block of windows at once — QK^T, +relative-position bias,
++shift mask, softmax, PV — entirely in VMEM, batched over (windows ×
+heads) so the MXU sees one big batched matmul per program.
+
+Works on pre-partitioned qkv (use ops/window_utils.window_partition, whose
+roll/reshape XLA fuses into the producing matmul's epilogue). The bias and
+shift mask are pre-combined host-side into one additive (nW, heads, Np, Np)
+tensor whose block is selected per program via the index map — no gather in
+the kernel.
+
+Token count N (e.g. 49) is padded to a sublane multiple; padded KEY
+positions carry -inf in the combined bias so they vanish in the softmax.
+Differentiable via jax.custom_vjp? Not needed: the kernel is re-derived by
+autodiff through a recompute wrapper (window N is tiny; recompute is free
+relative to HBM traffic), see ``window_attention`` below.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..window_utils import windowed_attention_reference
+from .common import interpret_mode
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
+    # blocks: q/k/v (WB, heads, Np, d); bias (WB, heads, Np, Np)
+    q = q_ref[...]
+    k = k_ref[...]
+    v = v_ref[...]
+    s = jax.lax.dot_general(
+        q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)          # (WB, heads, Np, Np)
+    s = s * scale + bias_ref[...]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)
+    o_ref[...] = o.astype(o_ref.dtype)
+
+
+def window_attention(qkv: jax.Array, bias: jax.Array,
+                     mask: Optional[jax.Array] = None,
+                     windows_per_block: int = 8) -> jax.Array:
+    """Fused attention over partitioned windows.
+
+    qkv:  (BW, N, 3, heads, d) — BW = batch*num_windows, N = window².
+    bias: (heads, N, N) relative-position bias (trainable).
+    mask: (nW, N, N) additive shift mask or None.
+    Returns (BW, N, heads*d).
+    """
+    bw, n, three, heads, d = qkv.shape
+    assert three == 3
+    np_pad = _round_up(n, 8)
+    nw = mask.shape[0] if mask is not None else 1
+    wb = windows_per_block
+    while wb > 1 and bw % wb:
+        wb //= 2
+
+    # combined additive term, (nW, heads, Np, Np); padded keys get -1e9
+    comb = jnp.broadcast_to(bias[None].astype(jnp.float32),
+                            (nw, heads, n, n))
+    if mask is not None:
+        comb = comb + mask[:, None].astype(jnp.float32)
+    comb = jnp.pad(comb, ((0, 0), (0, 0), (0, np_pad - n),
+                          (0, np_pad - n)), constant_values=-1e9)
+    # tile so a WB-window block always sees its own mask rows: tiling to
+    # lcm(nW, wb) makes block i's rows [(i*wb) % nW, ...] line up with the
+    # index map's (i % (nb/wb)) block selection.
+    if nw % wb:
+        comb = jnp.tile(comb, (int(np.lcm(nw, wb) // nw), 1, 1, 1))
+    nb = comb.shape[0]
+
+    q = jnp.moveaxis(qkv[:, :, 0], 1, 2)   # (BW, heads, N, d)
+    k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
+    v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
+    pad = ((0, 0), (0, 0), (0, np_pad - n), (0, 0))
+    q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+
+    grid = (bw // wb,)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=d ** -0.5),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((wb, heads, np_pad, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((wb, heads, np_pad, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((wb, heads, np_pad, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((wb, heads, np_pad, np_pad),
+                         lambda i, _nb=nb // wb: (i % _nb, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((wb, heads, np_pad, d),
+                               lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bw, heads, np_pad, d), qkv.dtype),
+        interpret=interpret_mode(),
+    )(q, k, v, comb)
+    out = out[:, :, :n, :]                  # drop padded query rows
+    return jnp.moveaxis(out, 1, 2).reshape(bw, n, heads * d)
+
+
+def window_attention_checkpointed(qkv, bias, mask=None, **kw):
+    """Differentiable wrapper: forward runs the fused kernel, backward
+    re-derives through the lax reference under jax.checkpoint (window
+    attention is tiny; recompute beats storing per-window P matrices)."""
+
+    @jax.custom_vjp
+    def f(qkv, bias):
+        return window_attention(qkv, bias, mask, **kw)
+
+    def fwd(qkv, bias):
+        return f(qkv, bias), (qkv, bias)
+
+    def bwd(res, g):
+        qkv, bias = res
+        _, vjp = jax.vjp(
+            lambda a, b: windowed_attention_reference(a, b, mask), qkv, bias)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f(qkv, bias)
