@@ -1,1 +1,2 @@
-from . import lenet, swin, vit  # noqa: F401  (import registers factories)
+from . import (cnns, convnext, lenet, mobile, repvgg, resnet, swin,  # noqa: F401
+               vit)  # import registers factories

@@ -1,0 +1,157 @@
+"""VGG + GoogLeNet (Inception v1) — classic CNN baselines.
+
+Surface of classification/vggNet (cfg-list VGG-11/13/16/19 builder) and
+classification/GoogleNet (Inception v1 with aux classifier heads,
+B-harness). The aux heads are returned during training (the caller weighs
+them 0.3 as the reference harness does).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+
+VGG_CFGS: Dict[str, Sequence[Union[int, str]]] = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 1000
+    use_bn: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv_i = 0
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding="SAME",
+                            use_bias=not self.use_bn, dtype=self.dtype,
+                            name=f"conv{conv_i}")(x)
+                if self.use_bn:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, dtype=self.dtype,
+                                     name=f"bn{conv_i}")(x)
+                x = nn.relu(x)
+                conv_i += 1
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(4096, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=self.dtype, name="fc2")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+class InceptionBlock(nn.Module):
+    c1: int
+    c2: Tuple[int, int]
+    c3: Tuple[int, int]
+    c4: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, dtype=self.dtype, padding="SAME")
+        b1 = nn.relu(conv(self.c1, (1, 1), name="b1")(x))
+        b2 = nn.relu(conv(self.c2[0], (1, 1), name="b2a")(x))
+        b2 = nn.relu(conv(self.c2[1], (3, 3), name="b2b")(b2))
+        b3 = nn.relu(conv(self.c3[0], (1, 1), name="b3a")(x))
+        b3 = nn.relu(conv(self.c3[1], (5, 5), name="b3b")(b3))
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = nn.relu(conv(self.c4, (1, 1), name="b4")(b4))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class AuxHead(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = nn.relu(nn.Conv(128, (1, 1), dtype=self.dtype, name="conv")(x))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(1024, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="fc2")(x).astype(jnp.float32)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    aux_logits: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, dtype=self.dtype, padding="SAME")
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(64, (7, 7), strides=(2, 2), name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(conv(64, (1, 1), name="conv2")(x))
+        x = nn.relu(conv(192, (3, 3), name="conv3")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionBlock(64, (96, 128), (16, 32), 32, self.dtype,
+                           name="inc3a")(x)
+        x = InceptionBlock(128, (128, 192), (32, 96), 64, self.dtype,
+                           name="inc3b")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionBlock(192, (96, 208), (16, 48), 64, self.dtype,
+                           name="inc4a")(x)
+        aux1 = (AuxHead(self.num_classes, self.dtype, name="aux1")(x, train)
+                if self.aux_logits and train else None)
+        x = InceptionBlock(160, (112, 224), (24, 64), 64, self.dtype,
+                           name="inc4b")(x)
+        x = InceptionBlock(128, (128, 256), (24, 64), 64, self.dtype,
+                           name="inc4c")(x)
+        x = InceptionBlock(112, (144, 288), (32, 64), 64, self.dtype,
+                           name="inc4d")(x)
+        aux2 = (AuxHead(self.num_classes, self.dtype, name="aux2")(x, train)
+                if self.aux_logits and train else None)
+        x = InceptionBlock(256, (160, 320), (32, 128), 128, self.dtype,
+                           name="inc4e")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionBlock(256, (160, 320), (32, 128), 128, self.dtype,
+                           name="inc5a")(x)
+        x = InceptionBlock(384, (192, 384), (48, 128), 128, self.dtype,
+                           name="inc5b")(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="fc")(x.astype(self.dtype))
+        logits = logits.astype(jnp.float32)
+        if self.aux_logits and train:
+            return logits, (aux1, aux2)
+        return logits
+
+
+for _name, _cfg in VGG_CFGS.items():
+    def _mk(cfg):
+        def build(num_classes: int = 1000, **kw):
+            return VGG(cfg=cfg, num_classes=num_classes, **kw)
+        return build
+    MODELS.register(_name)(_mk(_cfg))
+
+
+@MODELS.register("googlenet")
+def googlenet(num_classes: int = 1000, **kw):
+    return GoogLeNet(num_classes=num_classes, **kw)

@@ -1,0 +1,150 @@
+"""ConvNeXt + CoAtNet — modern conv / conv-attention hybrids.
+
+Surface of classification/convNext (ConvNeXt-T/S/B blocks: 7x7 depthwise,
+LN, pointwise MLP, layer scale, stochastic depth) and classification/
+coatNet (MBConv stages then relative-attention transformer stages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from .mobile import InvertedResidual
+from .vit import Attention, DropPath
+
+
+class ConvNeXtBlock(nn.Module):
+    dim: int
+    drop_path_rate: float = 0.0
+    layer_scale_init: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        y = nn.Conv(self.dim, (7, 7), padding="SAME",
+                    feature_group_count=self.dim, dtype=self.dtype,
+                    name="dwconv")(x)
+        y = nn.LayerNorm(dtype=self.dtype, name="norm")(y)
+        y = nn.Dense(4 * self.dim, dtype=self.dtype, name="pw1")(y)
+        y = nn.gelu(y, approximate=True)
+        y = nn.Dense(self.dim, dtype=self.dtype, name="pw2")(y)
+        gamma = self.param("gamma",
+                           nn.initializers.constant(self.layer_scale_init),
+                           (self.dim,), jnp.float32)
+        y = y * gamma.astype(y.dtype)
+        return x + DropPath(self.drop_path_rate)(y, deterministic)
+
+
+class ConvNeXt(nn.Module):
+    depths: Sequence[int] = (3, 3, 9, 3)
+    dims: Sequence[int] = (96, 192, 384, 768)
+    num_classes: int = 1000
+    drop_path_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        deterministic = not train
+        x = x.astype(self.dtype)
+        dpr = np.linspace(0, self.drop_path_rate, sum(self.depths))
+        bi = 0
+        for si, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if si == 0:
+                x = nn.Conv(dim, (4, 4), strides=(4, 4), dtype=self.dtype,
+                            name="stem")(x)
+                x = nn.LayerNorm(dtype=self.dtype, name="stem_norm")(x)
+            else:
+                x = nn.LayerNorm(dtype=self.dtype, name=f"down{si}_norm")(x)
+                x = nn.Conv(dim, (2, 2), strides=(2, 2), dtype=self.dtype,
+                            name=f"down{si}")(x)
+            for i in range(depth):
+                x = ConvNeXtBlock(dim, float(dpr[bi]), dtype=self.dtype,
+                                  name=f"stage{si}_block{i}")(x, deterministic)
+                bi += 1
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.LayerNorm(name="head_norm")(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+class CoAtNet(nn.Module):
+    """C-C-T-T layout: conv stem, two MBConv stages, two transformer
+    stages (coatNet surface)."""
+    num_classes: int = 1000
+    dims: Sequence[int] = (64, 96, 192, 384, 768)
+    depths: Sequence[int] = (2, 2, 3, 5, 2)
+    num_heads: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        deterministic = not train
+        x = x.astype(self.dtype)
+        # s0 conv stem
+        for i in range(self.depths[0]):
+            x = nn.Conv(self.dims[0], (3, 3),
+                        strides=(2, 2) if i == 0 else (1, 1),
+                        padding="SAME", dtype=self.dtype,
+                        name=f"stem{i}")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name=f"stem{i}_bn")(x)
+            x = nn.gelu(x, approximate=True)
+        # s1, s2: MBConv
+        for si in (1, 2):
+            for i in range(self.depths[si]):
+                x = InvertedResidual(self.dims[si], 2 if i == 0 else 1,
+                                     expand=4, use_se=True,
+                                     dtype=self.dtype,
+                                     name=f"s{si}_mb{i}")(x, train)
+        # s3, s4: transformer with downsampling by pooling
+        for si in (3, 4):
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            b, h, w, c = x.shape
+            x = x.reshape(b, h * w, c)
+            x = nn.Dense(self.dims[si], dtype=self.dtype,
+                         name=f"s{si}_proj")(x)
+            for i in range(self.depths[si]):
+                y = nn.LayerNorm(dtype=self.dtype,
+                                 name=f"s{si}_b{i}_norm1")(x)
+                y = Attention(self.num_heads, dtype=self.dtype,
+                              name=f"s{si}_b{i}_attn")(y, deterministic)
+                x = x + y
+                y = nn.LayerNorm(dtype=self.dtype,
+                                 name=f"s{si}_b{i}_norm2")(x)
+                y = nn.Dense(4 * self.dims[si], dtype=self.dtype,
+                             name=f"s{si}_b{i}_mlp1")(y)
+                y = nn.gelu(y, approximate=True)
+                y = nn.Dense(self.dims[si], dtype=self.dtype,
+                             name=f"s{si}_b{i}_mlp2")(y)
+                x = x + y
+            x = x.reshape(b, h, w, self.dims[si])
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("convnext_tiny")
+def convnext_tiny(num_classes: int = 1000, **kw):
+    return ConvNeXt(num_classes=num_classes, **kw)
+
+
+@MODELS.register("convnext_small")
+def convnext_small(num_classes: int = 1000, **kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), num_classes=num_classes, **kw)
+
+
+@MODELS.register("convnext_base")
+def convnext_base(num_classes: int = 1000, **kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024),
+                    num_classes=num_classes, **kw)
+
+
+@MODELS.register("coatnet_0")
+def coatnet_0(num_classes: int = 1000, **kw):
+    return CoAtNet(num_classes=num_classes, **kw)

@@ -1,0 +1,240 @@
+"""Mobile/efficient CNNs: ShuffleNetV2, MobileNetV2, EfficientNet.
+
+Surface of classification/ShuffleNet (v2 channel shuffle),
+classification/efficientNet (B0..B7 MBConv scaling), and MobileNetV2
+(the fasterRcnn alternative backbone, detection/fasterRcnn/
+models/backbone/mobilenetv2_model.py).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from .resnet import SEModule
+
+
+def channel_shuffle(x, groups: int = 2):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(b, h, w, c)
+
+
+class ShuffleV2Block(nn.Module):
+    out_ch: int
+    stride: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        branch = self.out_ch // 2
+        if self.stride == 1:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+        else:
+            # spatial-down branch processes the whole input
+            x1 = nn.Conv(x.shape[-1], (3, 3), strides=(2, 2), padding="SAME",
+                         feature_group_count=x.shape[-1], use_bias=False,
+                         dtype=self.dtype, name="proj_dw")(x)
+            x1 = norm(name="proj_dw_bn")(x1)
+            x1 = nn.Conv(branch, (1, 1), use_bias=False, dtype=self.dtype,
+                         name="proj_pw")(x1)
+            x1 = nn.relu(norm(name="proj_pw_bn")(x1))
+            x2 = x
+        y = nn.Conv(branch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pw1")(x2)
+        y = nn.relu(norm(name="pw1_bn")(y))
+        y = nn.Conv(branch, (3, 3), strides=(self.stride,) * 2,
+                    padding="SAME", feature_group_count=branch,
+                    use_bias=False, dtype=self.dtype, name="dw")(y)
+        y = norm(name="dw_bn")(y)
+        y = nn.Conv(branch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pw2")(y)
+        y = nn.relu(norm(name="pw2_bn")(y))
+        return channel_shuffle(jnp.concatenate([x1, y], axis=-1))
+
+
+class ShuffleNetV2(nn.Module):
+    stage_repeats: Sequence[int] = (4, 8, 4)
+    stage_channels: Sequence[int] = (116, 232, 464)
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(24, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for si, (reps, ch) in enumerate(zip(self.stage_repeats,
+                                            self.stage_channels)):
+            for i in range(reps):
+                x = ShuffleV2Block(ch, 2 if i == 0 else 1, self.dtype,
+                                   name=f"stage{si}_block{i}")(x, train)
+        x = nn.Conv(1024, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+class InvertedResidual(nn.Module):
+    """MBConv: expand -> depthwise -> (SE) -> project."""
+    out_ch: int
+    stride: int
+    expand: int = 6
+    kernel: int = 3
+    use_se: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False, dtype=self.dtype,
+                        name="expand")(y)
+            y = nn.silu(norm(name="expand_bn")(y)) if self.use_se else \
+                nn.relu6(norm(name="expand_bn")(y))
+        y = nn.Conv(hidden, (self.kernel,) * 2, strides=(self.stride,) * 2,
+                    padding="SAME", feature_group_count=hidden,
+                    use_bias=False, dtype=self.dtype, name="dw")(y)
+        y = nn.silu(norm(name="dw_bn")(y)) if self.use_se else \
+            nn.relu6(norm(name="dw_bn")(y))
+        if self.use_se:
+            y = SEModule(reduction=4 * self.expand, dtype=self.dtype,
+                         name="se")(y)
+        y = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="project")(y)
+        y = norm(name="project_bn")(y)
+        if self.stride == 1 and in_ch == self.out_ch:
+            y = x + y
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dtype: Any = jnp.bfloat16
+    return_features: bool = False
+
+    # (expand, out_ch, repeats, stride)
+    cfg: Sequence[Tuple[int, int, int, int]] = (
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            return max(8, int(ch * self.width_mult + 4) // 8 * 8)
+        x = x.astype(self.dtype)
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu6(x)
+        feats = {}
+        stage = 2
+        for bi, (t, ch, reps, s) in enumerate(self.cfg):
+            for i in range(reps):
+                x = InvertedResidual(c(ch), s if i == 0 else 1, t,
+                                     dtype=self.dtype,
+                                     name=f"block{bi}_{i}")(x, train)
+            # tap the LAST block at each stride level: just before the next
+            # stage downsamples, or at the end of the network
+            next_s = self.cfg[bi + 1][3] if bi + 1 < len(self.cfg) else 2
+            if next_s == 2:
+                feats[f"c{stage}"] = x
+                stage += 1
+        x = nn.Conv(c(1280), (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
+        x = nn.relu6(x)
+        if self.return_features:
+            feats["top"] = x
+            return feats
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+class EfficientNet(nn.Module):
+    """EfficientNet-B0 base scaled by (width, depth) coefficients
+    (efficientNet trans of B0..B7 scaling table)."""
+    num_classes: int = 1000
+    width_coef: float = 1.0
+    depth_coef: float = 1.0
+    dropout: float = 0.2
+    dtype: Any = jnp.bfloat16
+
+    # (expand, channels, repeats, stride, kernel)
+    cfg: Sequence[Tuple[int, int, int, int, int]] = (
+        (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+        (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+        (6, 320, 1, 1, 3))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            ch = ch * self.width_coef
+            return max(8, int(ch + 4) // 8 * 8)
+
+        def d(reps):
+            return int(math.ceil(reps * self.depth_coef))
+        x = x.astype(self.dtype)
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.silu(x)
+        for bi, (t, ch, reps, s, k) in enumerate(self.cfg):
+            for i in range(d(reps)):
+                x = InvertedResidual(c(ch), s if i == 0 else 1, t, k,
+                                     use_se=True, dtype=self.dtype,
+                                     name=f"block{bi}_{i}")(x, train)
+        x = nn.Conv(c(1280), (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
+        x = nn.silu(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("shufflenet_v2_x1_0")
+def shufflenet_v2_x1_0(num_classes: int = 1000, **kw):
+    return ShuffleNetV2(num_classes=num_classes, **kw)
+
+
+@MODELS.register("mobilenet_v2")
+def mobilenet_v2(num_classes: int = 1000, **kw):
+    return MobileNetV2(num_classes=num_classes, **kw)
+
+
+_EFFNET_SCALING = {          # width, depth, dropout (B0..B7 table)
+    "b0": (1.0, 1.0, 0.2), "b1": (1.0, 1.1, 0.2), "b2": (1.1, 1.2, 0.3),
+    "b3": (1.2, 1.4, 0.3), "b4": (1.4, 1.8, 0.4), "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5), "b7": (2.0, 3.1, 0.5),
+}
+
+for _suffix, (_w, _d, _p) in _EFFNET_SCALING.items():
+    def _mk(w, dd, p):
+        def build(num_classes: int = 1000, **kw):
+            return EfficientNet(num_classes=num_classes, width_coef=w,
+                                depth_coef=dd, dropout=p, **kw)
+        return build
+    MODELS.register(f"efficientnet_{_suffix}")(_mk(_w, _d, _p))

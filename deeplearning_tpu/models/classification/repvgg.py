@@ -1,0 +1,133 @@
+"""RepVGG: train-time 3x3+1x1+identity branches → deploy-time single 3x3.
+
+Surface of classification/RepVGG (models/ get_RepVGG_func_by_name,
+repvgg_model_convert; convert.py:17 CLI). The structural
+re-parameterization is a pure pytree→pytree transform here
+(``reparameterize``): fold each branch's BN into its conv, pad the 1x1 to
+3x3, add the identity as a centered-impulse kernel, and emit params for
+the ``deploy=True`` model — no module surgery, no state_dict games.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+
+
+class RepVGGBlock(nn.Module):
+    out_ch: int
+    stride: int = 1
+    groups: int = 1
+    deploy: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.deploy:
+            # explicit (1,1) padding: keeps the 3x3 window centered on the
+            # same taps as the 1x1 branch under stride 2 (SAME would pad
+            # asymmetrically and break reparam equivalence)
+            y = nn.Conv(self.out_ch, (3, 3), strides=(self.stride,) * 2,
+                        padding=((1, 1), (1, 1)),
+                        feature_group_count=self.groups,
+                        use_bias=True, dtype=self.dtype, name="reparam")(x)
+            return nn.relu(y)
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.9, epsilon=1e-5,
+                                         dtype=self.dtype, name=name)
+        y3 = nn.Conv(self.out_ch, (3, 3), strides=(self.stride,) * 2,
+                     padding=((1, 1), (1, 1)),
+                     feature_group_count=self.groups,
+                     use_bias=False, dtype=self.dtype, name="dense3")(x)
+        y3 = norm("bn3")(y3)
+        y1 = nn.Conv(self.out_ch, (1, 1), strides=(self.stride,) * 2,
+                     padding="VALID", feature_group_count=self.groups,
+                     use_bias=False, dtype=self.dtype, name="dense1")(x)
+        y1 = norm("bn1")(y1)
+        y = y3 + y1
+        if self.stride == 1 and x.shape[-1] == self.out_ch:
+            y = y + norm("bnid")(x)
+        return nn.relu(y)
+
+
+class RepVGG(nn.Module):
+    num_blocks: Sequence[int] = (2, 4, 14, 1)
+    width_mult: Sequence[float] = (0.75, 0.75, 0.75, 2.5)
+    num_classes: int = 1000
+    deploy: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        base = (64, 128, 256, 512)
+        in_planes = min(64, int(64 * self.width_mult[0]))
+        x = RepVGGBlock(in_planes, 2, deploy=self.deploy, dtype=self.dtype,
+                        name="stage0")(x, train)
+        for si, (n, w) in enumerate(zip(self.num_blocks, self.width_mult)):
+            ch = int(base[si] * w)
+            for i in range(n):
+                x = RepVGGBlock(ch, 2 if i == 0 else 1,
+                                deploy=self.deploy, dtype=self.dtype,
+                                name=f"stage{si + 1}_block{i}")(x, train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+def _fuse_bn(kernel: np.ndarray, bn: Dict[str, np.ndarray],
+             stats: Dict[str, np.ndarray], eps: float = 1e-5):
+    """Fold BN(scale,bias,mean,var) into conv kernel (HWIO) + bias."""
+    gamma, beta = np.asarray(bn["scale"]), np.asarray(bn["bias"])
+    mean, var = np.asarray(stats["mean"]), np.asarray(stats["var"])
+    std = np.sqrt(var + eps)
+    return kernel * (gamma / std), beta - mean * gamma / std
+
+
+def reparameterize(params: Dict, batch_stats: Dict) -> Dict:
+    """Train-time params → deploy-time params (single fused 3x3/block)."""
+    out: Dict[str, Any] = {}
+    for name, block in params.items():
+        if not (isinstance(block, dict) and "dense3" in block):
+            out[name] = jax.tree.map(np.asarray, block)
+            continue
+        stats = batch_stats[name]
+        k3, b3 = _fuse_bn(np.asarray(block["dense3"]["kernel"]),
+                          block["bn3"], stats["bn3"])
+        k1, b1 = _fuse_bn(np.asarray(block["dense1"]["kernel"]),
+                          block["bn1"], stats["bn1"])
+        k1 = np.pad(k1, ((1, 1), (1, 1), (0, 0), (0, 0)))
+        kernel, bias = k3 + k1, b3 + b1
+        if "bnid" in block:
+            in_ch = kernel.shape[2]
+            out_ch = kernel.shape[3]
+            kid = np.zeros((3, 3, in_ch, out_ch), kernel.dtype)
+            for o in range(out_ch):
+                kid[1, 1, o % in_ch, o] = 1.0
+            kid, bid = _fuse_bn(kid, block["bnid"], stats["bnid"])
+            kernel, bias = kernel + kid, bias + bid
+        out[name] = {"reparam": {"kernel": kernel, "bias": bias}}
+    return out
+
+
+_WIDTHS = {
+    "repvgg_a0": ((2, 4, 14, 1), (0.75, 0.75, 0.75, 2.5)),
+    "repvgg_a1": ((2, 4, 14, 1), (1.0, 1.0, 1.0, 2.5)),
+    "repvgg_a2": ((2, 4, 14, 1), (1.5, 1.5, 1.5, 2.75)),
+    "repvgg_b0": ((4, 6, 16, 1), (1.0, 1.0, 1.0, 2.5)),
+    "repvgg_b1": ((4, 6, 16, 1), (2.0, 2.0, 2.0, 4.0)),
+}
+
+for _name, (_blocks, _widths) in _WIDTHS.items():
+    def _mk(blocks, widths):
+        def build(num_classes: int = 1000, **kw):
+            return RepVGG(num_blocks=blocks, width_mult=widths,
+                          num_classes=num_classes, **kw)
+        return build
+    MODELS.register(_name)(_mk(_blocks, _widths))

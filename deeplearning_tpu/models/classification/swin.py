@@ -200,7 +200,8 @@ class PatchMerging(nn.Module):
 
 
 class SwinTransformer(nn.Module):
-    img_size: int = 224
+    # input-shape driven: resolution comes from the actual input (H, W);
+    # factory names carry the nominal train resolution only
     patch_size: int = 4
     num_classes: int = 1000
     embed_dim: int = 96

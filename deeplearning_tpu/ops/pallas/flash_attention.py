@@ -8,9 +8,9 @@ Forward and backward are Pallas kernels with a custom VJP; the backward
 recomputes P = exp(S - LSE) blockwise from the saved logsumexp, FlashAttention-2
 style.
 
-Also the building block for ring attention (parallel/ring_attention.py):
-the kernel exposes running (out, lse) so per-device KV chunks can be
-combined across the ``seq`` mesh axis.
+Ring attention (parallel/ring_attention.py) is the sequence-parallel
+counterpart; it currently uses its own lax per-chunk attention (this
+kernel's lse is saved for the VJP but not exposed publicly yet).
 
 Layout: (B, H, N, D). N must be a multiple of the block size — wrappers
 pad and mask via ``kv_len`` (the number of valid key tokens).

@@ -117,9 +117,10 @@ def window_attention(qkv: jax.Array, bias: jax.Array,
 
 
 def window_attention_checkpointed(qkv, bias, mask=None, **kw):
-    """Differentiable wrapper: forward runs the fused kernel, backward
-    re-derives through the lax reference under jax.checkpoint (window
-    attention is tiny; recompute beats storing per-window P matrices)."""
+    """Differentiable wrapper: forward runs the fused kernel; the custom
+    VJP recomputes the backward through the lax reference (which DOES
+    materialize per-window P matrices during the bwd pass — the fused
+    saving applies to the forward only)."""
 
     @jax.custom_vjp
     def f(qkv, bias):

@@ -1,0 +1,100 @@
+"""Model-zoo smoke + numeric tests for the classification backbones.
+
+The TPU version of the reference's per-project eval CLIs (SURVEY.md §4):
+every registered backbone must init + forward with finite outputs; models
+with special semantics (RepVGG reparam, GoogLeNet aux, BatchNorm variants)
+get targeted checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+
+SMALL_INPUT_MODELS = [
+    ("resnet18", {}),
+    ("resnet50", {}),
+    ("resnext50_32x4d", {}),
+    ("se_resnet18", {}),
+    ("sknet50", {}),
+    ("resnest50", {}),
+    ("shufflenet_v2_x1_0", {}),
+    ("mobilenet_v2", {}),
+    ("efficientnet_b0", {}),
+    ("convnext_tiny", {}),
+    ("repvgg_a0", {}),
+    ("coatnet_0", {}),
+]
+
+
+def _has_batch_stats(variables):
+    return "batch_stats" in variables
+
+
+class TestBackboneSmoke:
+    @pytest.mark.parametrize("name,kw", SMALL_INPUT_MODELS)
+    def test_forward_finite(self, name, kw):
+        model = MODELS.build(name, num_classes=7, dtype=jnp.float32, **kw)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 7)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_vgg_forward(self):
+        model = MODELS.build("vgg11", num_classes=5, dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (1, 5)
+
+    def test_googlenet_aux_heads(self):
+        model = MODELS.build("googlenet", num_classes=5, dtype=jnp.float32)
+        x = jnp.zeros((1, 96, 96, 3))
+        variables = model.init(jax.random.key(0), x, train=True)
+        out = model.apply(variables, x, train=True,
+                          rngs={"dropout": jax.random.key(1)})
+        logits, (aux1, aux2) = out
+        assert logits.shape == aux1.shape == aux2.shape == (1, 5)
+        eval_out = model.apply(variables, x, train=False)
+        assert eval_out.shape == (1, 5)
+
+    def test_batchnorm_models_train_mode_mutates_stats(self):
+        model = MODELS.build("resnet18", num_classes=3, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        assert _has_batch_stats(variables)
+        out, mutated = model.apply(variables, x, train=True,
+                                   mutable=["batch_stats"])
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(mutated["batch_stats"])
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+class TestRepVGGReparam:
+    def test_deploy_matches_train_forward(self):
+        from deeplearning_tpu.models.classification.repvgg import (
+            RepVGG, reparameterize)
+        model = RepVGG(num_blocks=(1, 1), width_mult=(0.25, 0.25),
+                       num_classes=4, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        # run a train step so BN stats are non-trivial
+        _, mutated = model.apply(variables, x, train=True,
+                                 mutable=["batch_stats"])
+        variables = {"params": variables["params"],
+                     "batch_stats": mutated["batch_stats"]}
+        ref = model.apply(variables, x, train=False)
+
+        deploy_model = RepVGG(num_blocks=(1, 1), width_mult=(0.25, 0.25),
+                              num_classes=4, deploy=True, dtype=jnp.float32)
+        deploy_params = reparameterize(variables["params"],
+                                       variables["batch_stats"])
+        out = deploy_model.apply({"params": deploy_params}, x, train=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)

@@ -100,7 +100,7 @@ class TestSwinModel:
     def test_swin_tiny_forward(self):
         from deeplearning_tpu.core.registry import MODELS
         model = MODELS.build("swin_tiny_patch4_window7_224", num_classes=10,
-                             img_size=112, patch_size=2, dtype=jnp.float32)
+                             patch_size=2, dtype=jnp.float32)
         x = jnp.zeros((2, 112, 112, 3))
         params = model.init(jax.random.key(0), x, train=False)["params"]
         out = model.apply({"params": params}, x, train=False)
@@ -110,7 +110,7 @@ class TestSwinModel:
     def test_swin_v2_forward(self):
         from deeplearning_tpu.core.registry import MODELS
         model = MODELS.build("swinv2_tiny_patch4_window7_224", num_classes=10,
-                             img_size=112, patch_size=2, dtype=jnp.float32)
+                             patch_size=2, dtype=jnp.float32)
         x = jnp.zeros((2, 112, 112, 3))
         params = model.init(jax.random.key(0), x, train=False)["params"]
         out = model.apply({"params": params}, x, train=False)
@@ -119,7 +119,7 @@ class TestSwinModel:
 
     def test_swin_pallas_path_matches_reference_path(self):
         from deeplearning_tpu.core.registry import MODELS
-        kw = dict(num_classes=10, img_size=112, patch_size=2,
+        kw = dict(num_classes=10, patch_size=2,
                   dtype=jnp.float32, drop_path_rate=0.0)
         m_ref = MODELS.build("swin_tiny_patch4_window7_224", **kw)
         m_pal = MODELS.build("swin_tiny_patch4_window7_224", use_pallas=True,
