@@ -1,1 +1,1 @@
-from . import metrics  # noqa: F401
+from . import coco_eval, metrics, voc  # noqa: F401

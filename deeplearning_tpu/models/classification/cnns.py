@@ -117,8 +117,10 @@ class GoogLeNet(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         x = InceptionBlock(192, (96, 208), (16, 48), 64, self.dtype,
                            name="inc4a")(x)
+        # aux heads always run so their params exist under eval-mode init;
+        # the tuple is only returned in train mode
         aux1 = (AuxHead(self.num_classes, self.dtype, name="aux1")(x, train)
-                if self.aux_logits and train else None)
+                if self.aux_logits else None)
         x = InceptionBlock(160, (112, 224), (24, 64), 64, self.dtype,
                            name="inc4b")(x)
         x = InceptionBlock(128, (128, 256), (24, 64), 64, self.dtype,
@@ -126,7 +128,7 @@ class GoogLeNet(nn.Module):
         x = InceptionBlock(112, (144, 288), (32, 64), 64, self.dtype,
                            name="inc4d")(x)
         aux2 = (AuxHead(self.num_classes, self.dtype, name="aux2")(x, train)
-                if self.aux_logits and train else None)
+                if self.aux_logits else None)
         x = InceptionBlock(256, (160, 320), (32, 128), 128, self.dtype,
                            name="inc4e")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -148,18 +148,18 @@ class MobileNetV2(nn.Module):
                          dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu6(x)
         feats = {}
-        stage = 2
+        cur_stride = 2                      # after the stem conv
         for bi, (t, ch, reps, s) in enumerate(self.cfg):
+            cur_stride *= s
             for i in range(reps):
                 x = InvertedResidual(c(ch), s if i == 0 else 1, t,
                                      dtype=self.dtype,
                                      name=f"block{bi}_{i}")(x, train)
-            # tap the LAST block at each stride level: just before the next
-            # stage downsamples, or at the end of the network
+            # tap the LAST block at each stride level (cN <=> stride 2^N,
+            # matching the ResNet backbone convention FPN consumers assume)
             next_s = self.cfg[bi + 1][3] if bi + 1 < len(self.cfg) else 2
-            if next_s == 2:
-                feats[f"c{stage}"] = x
-                stage += 1
+            if next_s == 2 and cur_stride >= 4:
+                feats[f"c{cur_stride.bit_length() - 1}"] = x
         x = nn.Conv(c(1280), (1, 1), use_bias=False, dtype=self.dtype,
                     name="head_conv")(x)
         x = nn.relu6(x)

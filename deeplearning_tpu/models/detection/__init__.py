@@ -1,0 +1,1 @@
+from . import fpn, retinanet  # noqa: F401

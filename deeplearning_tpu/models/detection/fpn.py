@@ -1,0 +1,55 @@
+"""Feature Pyramid Network neck.
+
+Surface of detection/FPN/fpn_model.py (standalone ResNet50+FPN reference)
+and fasterRcnn models/backbone/resnet50_fpn.py (BackboneWithFPN +
+LastLevelMaxPool): lateral 1x1 + top-down upsample + 3x3 smooth, extra
+levels by stride-2 pooling/conv (RetinaNet's P6/P7,
+network_files/retinanet.py LastLevelP6P7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FPN(nn.Module):
+    out_channels: int = 256
+    extra_levels: str = "pool"     # 'pool' (faster-rcnn P6) | 'p6p7'
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        names = sorted(feats, key=lambda k: int(k[1:]))      # c2 < c3 < ...
+        laterals = {
+            n: nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                       name=f"lateral_{n}")(feats[n]) for n in names}
+        out: Dict[str, jax.Array] = {}
+        prev: Optional[jax.Array] = None
+        for n in reversed(names):
+            x = laterals[n]
+            if prev is not None:
+                b, h, w, c = x.shape
+                up = jax.image.resize(prev, (b, h, w, c), "nearest")
+                x = x + up
+            prev = x
+            out[f"p{n[1:]}"] = nn.Conv(self.out_channels, (3, 3),
+                                       padding="SAME", dtype=self.dtype,
+                                       name=f"smooth_{n}")(x)
+        top = int(names[-1][1:])
+        if self.extra_levels == "pool":
+            out[f"p{top + 1}"] = nn.max_pool(
+                out[f"p{top}"], (1, 1), strides=(2, 2))
+        elif self.extra_levels == "p6p7":
+            p6 = nn.Conv(self.out_channels, (3, 3), strides=(2, 2),
+                         padding="SAME", dtype=self.dtype,
+                         name="p6")(feats[names[-1]])
+            p7 = nn.Conv(self.out_channels, (3, 3), strides=(2, 2),
+                         padding="SAME", dtype=self.dtype,
+                         name="p7")(nn.relu(p6))
+            out[f"p{top + 1}"] = p6
+            out[f"p{top + 2}"] = p7
+        return out

@@ -1,0 +1,187 @@
+"""RetinaNet: one-stage focal-loss detector on ResNet-FPN.
+
+Surface of detection/RetinaNet: RetinaNetClassificationHead
+(network_files/retinanet.py:23 — 4 convs + K*A sigmoid logits, prior-prob
+bias init), RetinaNetRegressionHead (:120 — 4 convs + 4*A deltas),
+RetinaNet (:238, forward :480: backbone→FPN p3-p7→heads→anchors→
+loss/postprocess), sigmoid focal loss (network_files/losses.py:5),
+anchor machinery (network_files/anchor_utils.py), Matcher thresholds
+0.5/0.4 with low-quality matches.
+
+TPU-first: the whole model is one jittable function over fixed-size
+inputs; gt boxes come padded (MAX_GT, 4) + validity mask; postprocess
+returns fixed (max_det) boxes + validity — no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ...ops import anchors as anc
+from ...ops import boxes as box_ops
+from ...ops import losses as L
+from ...ops import matcher as M
+from ...ops import nms as nms_ops
+from ..classification.resnet import ResNet
+
+
+class RetinaHead(nn.Module):
+    """Shared-conv classification or regression tower."""
+    num_outputs: int               # K*A or 4*A
+    num_convs: int = 4
+    channels: int = 256
+    prior_bias: Optional[float] = None   # classification prior init
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.num_convs):
+            x = nn.Conv(self.channels, (3, 3), padding="SAME",
+                        dtype=self.dtype, name=f"conv{i}")(x)
+            x = nn.relu(x)
+        bias_init = nn.initializers.zeros
+        if self.prior_bias is not None:
+            bias_init = nn.initializers.constant(self.prior_bias)
+        return nn.Conv(self.num_outputs, (3, 3), padding="SAME",
+                       dtype=self.dtype, bias_init=bias_init,
+                       kernel_init=nn.initializers.normal(0.01),
+                       name="pred")(x)
+
+
+class RetinaNet(nn.Module):
+    num_classes: int = 20
+    backbone_sizes: Sequence[int] = (3, 4, 6, 3)     # resnet50
+    anchors_per_loc: int = 9
+    fpn_channels: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images: jax.Array, train: bool = False
+                 ) -> Dict[str, Any]:
+        from .fpn import FPN
+        backbone = ResNet(stage_sizes=self.backbone_sizes,
+                          return_features=True, dtype=self.dtype,
+                          name="backbone")
+        feats = backbone(images, train=train)
+        feats = {k: v for k, v in feats.items() if k in ("c3", "c4", "c5")}
+        pyramid = FPN(self.fpn_channels, extra_levels="p6p7",
+                      dtype=self.dtype, name="fpn")(feats)
+
+        cls_head = RetinaHead(
+            self.num_classes * self.anchors_per_loc,
+            prior_bias=-math.log((1 - 0.01) / 0.01),
+            dtype=self.dtype, name="cls_head")
+        reg_head = RetinaHead(4 * self.anchors_per_loc, dtype=self.dtype,
+                              name="reg_head")
+
+        cls_logits, bbox_deltas, shapes = [], [], {}
+        for name in sorted(pyramid, key=lambda k: int(k[1:])):
+            f = pyramid[name]
+            shapes[name] = f.shape[1:3]
+            b = f.shape[0]
+            cls_logits.append(cls_head(f).reshape(
+                b, -1, self.num_classes).astype(jnp.float32))
+            bbox_deltas.append(reg_head(f).reshape(b, -1, 4).astype(
+                jnp.float32))
+        return {
+            "cls_logits": jnp.concatenate(cls_logits, axis=1),
+            "bbox_deltas": jnp.concatenate(bbox_deltas, axis=1),
+            "feature_shapes": shapes,
+        }
+
+
+def retinanet_anchors(image_hw: Tuple[int, int]) -> np.ndarray:
+    """All-level anchors for a fixed image size (host-side constant)."""
+    h, w = image_hw
+    shapes = {f"p{l}": (math.ceil(h / 2 ** l), math.ceil(w / 2 ** l))
+              for l in (3, 4, 5, 6, 7)}
+    strides = {k: 2 ** int(k[1]) for k in shapes}
+    all_anchors, _ = anc.pyramid_anchors(shapes, strides,
+                                         anc.retinanet_sizes())
+    return all_anchors
+
+
+def retinanet_loss(outputs: Dict, anchors: jax.Array, gt_boxes: jax.Array,
+                   gt_labels: jax.Array, gt_valid: jax.Array
+                   ) -> Dict[str, jax.Array]:
+    """Focal cls loss over all non-ignored anchors + smooth-L1 on positives
+    (RetinaNet compute_loss surface; matcher 0.5/0.4 w/ low-quality).
+
+    gt_boxes (B, G, 4); gt_labels (B, G) int; gt_valid (B, G) bool.
+    """
+    num_classes = outputs["cls_logits"].shape[-1]
+
+    def per_image(cls_logits, deltas, boxes, labels, valid):
+        iou = box_ops.box_iou(boxes, anchors)           # (G, A)
+        matches = M.match_anchors(iou, valid, 0.5, 0.4,
+                                  allow_low_quality=True)
+        pos = matches >= 0
+        ignore = matches == M.BETWEEN
+        safe = jnp.maximum(matches, 0)
+        target_cls = jax.nn.one_hot(labels[safe], num_classes) \
+            * pos[:, None]
+        cls_loss = L.sigmoid_focal_loss(
+            cls_logits, target_cls, reduction="none")
+        cls_loss = jnp.sum(cls_loss * (~ignore)[:, None])
+        reg_targets = box_ops.encode_boxes(boxes[safe], anchors)
+        reg_loss = L.smooth_l1(deltas, reg_targets, beta=1.0 / 9,
+                               reduction="none")
+        reg_loss = jnp.sum(reg_loss * pos[:, None])
+        num_pos = jnp.maximum(jnp.sum(pos), 1)
+        return cls_loss / num_pos, reg_loss / num_pos
+
+    cls_l, reg_l = jax.vmap(per_image)(
+        outputs["cls_logits"], outputs["bbox_deltas"],
+        gt_boxes, gt_labels, gt_valid)
+    return {"cls_loss": jnp.mean(cls_l), "reg_loss": jnp.mean(reg_l)}
+
+
+def retinanet_postprocess(outputs: Dict, anchors: jax.Array,
+                          image_hw: Tuple[int, int],
+                          score_thresh: float = 0.05,
+                          nms_thresh: float = 0.5,
+                          topk_candidates: int = 1000,
+                          max_det: int = 100) -> Dict[str, jax.Array]:
+    """Decode → top-k per image → class-aware NMS → fixed max_det outputs
+    (RetinaNet postprocess_detections surface, fixed-shape)."""
+
+    def per_image(cls_logits, deltas):
+        scores_all = jax.nn.sigmoid(cls_logits)          # (A, K)
+        flat = scores_all.reshape(-1)
+        k = min(topk_candidates, flat.shape[0])
+        top_scores, top_idx = jax.lax.top_k(flat, k)
+        anchor_idx = top_idx // cls_logits.shape[-1]
+        class_idx = top_idx % cls_logits.shape[-1]
+        boxes = box_ops.decode_boxes(deltas[anchor_idx],
+                                     anchors[anchor_idx])
+        boxes = box_ops.clip_boxes(boxes, image_hw)
+        keep_idx, keep_valid = nms_ops.batched_nms(
+            boxes, top_scores, class_idx, nms_thresh, max_det,
+            score_threshold=score_thresh)
+        out_boxes, out_scores, out_classes = nms_ops.gather_nms_outputs(
+            keep_idx, keep_valid, boxes, top_scores, class_idx)
+        return out_boxes, out_scores, out_classes, keep_valid
+
+    boxes, scores, classes, valid = jax.vmap(per_image)(
+        outputs["cls_logits"], outputs["bbox_deltas"])
+    return {"boxes": boxes, "scores": scores, "labels": classes,
+            "valid": valid}
+
+
+@MODELS.register("retinanet_resnet50_fpn")
+def retinanet_resnet50_fpn(num_classes: int = 20, **kw):
+    return RetinaNet(num_classes=num_classes, **kw)
+
+
+@MODELS.register("retinanet_resnet18_fpn")
+def retinanet_resnet18_fpn(num_classes: int = 20, **kw):
+    # small variant for tests/smoke
+    return RetinaNet(num_classes=num_classes, backbone_sizes=(2, 2, 2, 2),
+                     **kw)

@@ -1,0 +1,45 @@
+"""Build + load the native C++ helpers via g++ and ctypes.
+
+The reference ships compiled extensions built by setuptools/ninja (YOLOX
+setup.py:15-40 CppExtension 'yolox._C'; swin CUDAExtension). Here the
+native runtime pieces are plain C-ABI shared objects compiled on first
+use with g++ (pybind11 is not in this image) and cached next to the
+sources; ctypes does the binding.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS: dict = {}
+
+
+def _build(name: str) -> Optional[str]:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = os.path.join(_DIR, f"lib{name}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return out
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen lib<name>.so; None if unavailable."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        path = _build(name)
+        lib = ctypes.CDLL(path) if path else None
+        _LIBS[name] = lib
+        return lib

@@ -1,1 +1,2 @@
-from . import losses  # noqa: F401
+from . import anchors, attention, boxes, losses, matcher, nms, roi_align  # noqa: F401
+from . import window_utils  # noqa: F401

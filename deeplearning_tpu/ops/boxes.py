@@ -1,0 +1,145 @@
+"""Box ops: IoU, encode/decode, clip — fixed-shape and fully vectorized.
+
+Surface of detection/fasterRcnn/utils/boxes.py (:143 box_iou) and
+utils/det_utils.py (:137 BoxCoder encode/decode with weights and the
+bbox_xform_clip guard), shared by RetinaNet (network_files/boxes.py) and
+the YOLO heads. Boxes are (x1, y1, x2, y2); invalid/padded boxes are
+handled by callers via masks (the XLA static-shape idiom) rather than by
+shrinking arrays.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+BBOX_XFORM_CLIP = math.log(1000.0 / 16)
+
+
+def box_area(boxes: jax.Array) -> jax.Array:
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def box_iou(boxes1: jax.Array, boxes2: jax.Array) -> jax.Array:
+    """(N, 4) × (M, 4) → (N, M) IoU matrix."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def generalized_box_iou(boxes1: jax.Array, boxes2: jax.Array) -> jax.Array:
+    """GIoU matrix (FCOS models/loss.py:311 loss surface, matrix form)."""
+    iou = box_iou(boxes1, boxes2)
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    hull = wh[..., 0] * wh[..., 1]
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    inter = iou * (area1[:, None] + area2[None, :]) / (1 + iou)  # recover
+    union = area1[:, None] + area2[None, :] - inter
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)
+
+
+def elementwise_box_iou(boxes1: jax.Array, boxes2: jax.Array,
+                        kind: str = "iou") -> jax.Array:
+    """Paired IoU/GIoU/DIoU/CIoU of equal-shaped (..., 4) boxes (yolov5
+    utils/metrics.py bbox_iou surface — used by CIoU loss)."""
+    lt = jnp.maximum(boxes1[..., :2], boxes2[..., :2])
+    rb = jnp.minimum(boxes1[..., 2:], boxes2[..., 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    union = jnp.maximum(area1 + area2 - inter, 1e-9)
+    iou = inter / union
+    if kind == "iou":
+        return iou
+    hull_lt = jnp.minimum(boxes1[..., :2], boxes2[..., :2])
+    hull_rb = jnp.maximum(boxes1[..., 2:], boxes2[..., 2:])
+    hull_wh = jnp.clip(hull_rb - hull_lt, 0)
+    if kind == "giou":
+        hull = jnp.maximum(hull_wh[..., 0] * hull_wh[..., 1], 1e-9)
+        return iou - (hull - union) / hull
+    c2 = jnp.sum(jnp.square(hull_wh), -1) + 1e-9
+    ctr1 = (boxes1[..., :2] + boxes1[..., 2:]) / 2
+    ctr2 = (boxes2[..., :2] + boxes2[..., 2:]) / 2
+    rho2 = jnp.sum(jnp.square(ctr2 - ctr1), -1)
+    if kind == "diou":
+        return iou - rho2 / c2
+    if kind == "ciou":
+        w1 = boxes1[..., 2] - boxes1[..., 0]
+        h1 = jnp.maximum(boxes1[..., 3] - boxes1[..., 1], 1e-9)
+        w2 = boxes2[..., 2] - boxes2[..., 0]
+        h2 = jnp.maximum(boxes2[..., 3] - boxes2[..., 1], 1e-9)
+        v = (4 / math.pi ** 2) * jnp.square(
+            jnp.arctan(w2 / h2) - jnp.arctan(w1 / h1))
+        alpha = v / jnp.maximum(1 - iou + v, 1e-9)
+        alpha = jax.lax.stop_gradient(alpha)
+        return iou - rho2 / c2 - alpha * v
+    raise ValueError(kind)
+
+
+def encode_boxes(reference: jax.Array, proposals: jax.Array,
+                 weights: Tuple[float, float, float, float] = (1, 1, 1, 1)
+                 ) -> jax.Array:
+    """Regression targets (dx, dy, dw, dh) of ``reference`` (gt) w.r.t.
+    ``proposals`` (anchors) — BoxCoder.encode surface."""
+    wx, wy, ww, wh = weights
+    px = (proposals[..., 0] + proposals[..., 2]) / 2
+    py = (proposals[..., 1] + proposals[..., 3]) / 2
+    pw = jnp.maximum(proposals[..., 2] - proposals[..., 0], 1e-6)
+    ph = jnp.maximum(proposals[..., 3] - proposals[..., 1], 1e-6)
+    gx = (reference[..., 0] + reference[..., 2]) / 2
+    gy = (reference[..., 1] + reference[..., 3]) / 2
+    gw = jnp.maximum(reference[..., 2] - reference[..., 0], 1e-6)
+    gh = jnp.maximum(reference[..., 3] - reference[..., 1], 1e-6)
+    return jnp.stack([
+        wx * (gx - px) / pw, wy * (gy - py) / ph,
+        ww * jnp.log(gw / pw), wh * jnp.log(gh / ph)], axis=-1)
+
+
+def decode_boxes(deltas: jax.Array, anchors: jax.Array,
+                 weights: Tuple[float, float, float, float] = (1, 1, 1, 1)
+                 ) -> jax.Array:
+    """Apply (dx, dy, dw, dh) deltas to anchors — BoxCoder.decode surface
+    with the log-space clip (det_utils.py:225 bbox_xform_clip)."""
+    wx, wy, ww, wh = weights
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    dx = deltas[..., 0] / wx
+    dy = deltas[..., 1] / wy
+    dw = jnp.minimum(deltas[..., 2] / ww, BBOX_XFORM_CLIP)
+    dh = jnp.minimum(deltas[..., 3] / wh, BBOX_XFORM_CLIP)
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def clip_boxes(boxes: jax.Array, size_hw: Tuple[int, int]) -> jax.Array:
+    h, w = size_hw
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0, w), jnp.clip(boxes[..., 1], 0, h),
+        jnp.clip(boxes[..., 2], 0, w), jnp.clip(boxes[..., 3], 0, h)],
+        axis=-1)
+
+
+def remove_small_boxes_mask(boxes: jax.Array, min_size: float) -> jax.Array:
+    """Validity mask instead of index list (static shapes)."""
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    return (w >= min_size) & (h >= min_size)

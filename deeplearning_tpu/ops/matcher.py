@@ -1,0 +1,73 @@
+"""Anchor↔GT matching + balanced sampling as masked fixed-shape ops.
+
+Surface of detection/fasterRcnn/utils/det_utils.py: Matcher (:260 —
+IoU-threshold assignment with allow_low_quality_matches) and
+BalancedPositiveNegativeSampler (:7 — fixed pos/neg counts per image).
+XLA form: gt boxes are padded to a fixed count with a validity mask;
+matches are indices + category codes; "random" subsampling uses a
+top-k-of-random-keys trick so the selected count is exact without
+dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+BELOW_LOW = -1
+BETWEEN = -2
+
+
+def match_anchors(iou: jax.Array, gt_valid: jax.Array,
+                  high_threshold: float, low_threshold: float,
+                  allow_low_quality: bool = True) -> jax.Array:
+    """iou (G, A) with padded gt rows masked by gt_valid (G,) →
+    matches (A,): gt index, or BELOW_LOW / BETWEEN codes."""
+    iou = jnp.where(gt_valid[:, None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=0)                 # (A,)
+    best_iou = jnp.max(iou, axis=0)
+    matches = jnp.where(
+        best_iou >= high_threshold, best_gt,
+        jnp.where(best_iou >= low_threshold, BETWEEN, BELOW_LOW))
+    if allow_low_quality:
+        # for each valid gt, force-match its highest-IoU anchors (ties incl.)
+        best_anchor_iou = jnp.max(iou, axis=1, keepdims=True)   # (G, 1)
+        is_best = (iou >= best_anchor_iou - 1e-7) & (best_anchor_iou > 0) \
+            & gt_valid[:, None]
+        force = jnp.any(is_best, axis=0)
+        forced_gt = jnp.argmax(is_best, axis=0)
+        matches = jnp.where(force, forced_gt, matches)
+    return matches
+
+
+def balanced_sample(matches: jax.Array, rng: jax.Array,
+                    batch_size_per_image: int, positive_fraction: float
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Select up to num_pos positives and (batch - num_pos) negatives,
+    uniformly at random, as boolean masks (pos_mask, neg_mask) over anchors.
+
+    Exact-count random subset under static shapes: give each candidate a
+    random key, keep the top-k keys among candidates.
+    """
+    a = matches.shape[0]
+    pos_cand = matches >= 0
+    neg_cand = matches == BELOW_LOW
+    num_pos_target = int(batch_size_per_image * positive_fraction)
+
+    k_pos, k_neg = jax.random.split(rng)
+
+    def pick(cand, key, limit):
+        n_cand = jnp.sum(cand)
+        take = jnp.minimum(n_cand, limit)
+        scores = jnp.where(cand, jax.random.uniform(key, (a,)), -1.0)
+        # rank by random score; the top `take` candidates win
+        order = jnp.argsort(-scores)
+        rank = jnp.zeros((a,), jnp.int32).at[order].set(jnp.arange(a))
+        return cand & (rank < take)
+
+    pos_mask = pick(pos_cand, k_pos, num_pos_target)
+    num_pos = jnp.sum(pos_mask)
+    neg_mask = pick(neg_cand, k_neg, batch_size_per_image - num_pos)
+    return pos_mask, neg_mask

@@ -1,0 +1,71 @@
+"""Padded top-k NMS — the torchvision.ops.nms successor under XLA.
+
+The reference calls the compiled torchvision NMS everywhere
+(fasterRcnn/utils/boxes.py:32, RetinaNet network_files/boxes.py:35, YOLOX
+utils/boxes.py:57-67, yolov5 utils/general.py non_max_suppression). Those
+return variable-length index lists — impossible under XLA's static shapes.
+TPU-first formulation: NMS(boxes, scores) → (keep_indices[max_out],
+keep_mask[max_out]) with fixed ``max_out``; suppressed slots are masked.
+
+Algorithm: O(max_out · N) greedy — each of ``max_out`` fixed iterations
+selects the argmax of the still-alive scores and suppresses neighbors over
+the IoU threshold. All dense vector math (VPU-friendly); no data-dependent
+shapes. ``batched_nms`` uses the reference's category-offset trick
+(boxes.py:35-60) so classes never suppress each other.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .boxes import box_iou
+
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+        max_out: int, score_threshold: float = float("-inf")
+        ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy NMS. boxes (N,4), scores (N,) → (idx (max_out,), valid
+    (max_out,) bool). Padded slots have idx 0 and valid False."""
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+    alive = scores > score_threshold
+
+    def body(state, _):
+        alive, = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        suppress = iou[best] > iou_threshold
+        new_alive = alive & ~suppress & (jnp.arange(n) != best)
+        # if nothing valid remains, keep alive unchanged (all False anyway)
+        return (jnp.where(valid, new_alive, alive),), (best, valid)
+
+    (_,), (idx, valid) = jax.lax.scan(body, (alive,), None, length=max_out)
+    return idx, valid
+
+
+def batched_nms(boxes: jax.Array, scores: jax.Array, classes: jax.Array,
+                iou_threshold: float, max_out: int,
+                score_threshold: float = float("-inf")
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Class-aware NMS via per-class coordinate offsets
+    (fasterRcnn utils/boxes.py:35-60 trick, fixed-shape)."""
+    max_coord = jnp.max(boxes) + 1.0
+    offsets = classes.astype(boxes.dtype)[:, None] * max_coord
+    return nms(boxes + offsets, scores, iou_threshold, max_out,
+               score_threshold)
+
+
+def gather_nms_outputs(idx: jax.Array, valid: jax.Array, *arrays
+                       ) -> Tuple[jax.Array, ...]:
+    """Gather (boxes/scores/classes/...) at keep indices, zeroing padded
+    slots so downstream fixed-shape consumers see clean data."""
+    out = []
+    for a in arrays:
+        g = a[idx]
+        mask = valid.reshape(valid.shape + (1,) * (g.ndim - 1))
+        out.append(jnp.where(mask, g, 0))
+    return tuple(out)

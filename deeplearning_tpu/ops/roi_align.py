@@ -1,0 +1,102 @@
+"""RoIAlign as bilinear gather — the torchvision MultiScaleRoIAlign successor.
+
+The reference consumes torchvision's compiled RoIAlign
+(fasterRcnn/models/faster_rcnn.py:8,305 MultiScaleRoIAlign). XLA version:
+each output cell samples a fixed ``sampling_ratio²`` grid of bilinear
+points — a dense gather, fully vectorized over (rois × cells × samples),
+which XLA lowers to efficient dynamic-gathers. FPN level assignment
+follows the canonical heuristic (level = 4 + log2(sqrt(area)/224), clamped)
+with per-level compute + masked combine (static shapes; every roi is
+evaluated once per level and selected, trading FLOPs for shape stability —
+cheap because roi grids are tiny).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _bilinear(features: jax.Array, y: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample features (H, W, C) at float coords y/x (...,) → (..., C).
+    Out-of-bounds sampling returns 0 (torchvision semantics)."""
+    h, w, c = features.shape
+    in_bounds = (y >= -1.0) & (y <= h) & (x >= -1.0) & (x <= w)
+    y = jnp.clip(y, 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly = (y - y0)[..., None]
+    lx = (x - x0)[..., None]
+    v00 = features[y0, x0]
+    v01 = features[y0, x1]
+    v10 = features[y1, x0]
+    v11 = features[y1, x1]
+    val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    return val * in_bounds[..., None]
+
+
+def roi_align(features: jax.Array, rois: jax.Array, output_size: int,
+              spatial_scale: float = 1.0, sampling_ratio: int = 2,
+              aligned: bool = False) -> jax.Array:
+    """features (H, W, C); rois (R, 4) in image coords → (R, S, S, C)."""
+    s = output_size
+    sr = max(sampling_ratio, 1)
+    offset = 0.5 if aligned else 0.0
+    boxes = rois * spatial_scale - offset
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    roi_w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    roi_h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    bin_h = roi_h / s
+    bin_w = roi_w / s
+    # sample grid: (R, S, sr) per axis
+    iy = jnp.arange(s)
+    ir = jnp.arange(sr)
+    ys = (y1[:, None, None] + (iy[None, :, None]
+          + (ir[None, None, :] + 0.5) / sr) * bin_h[:, None, None])
+    xs = (x1[:, None, None] + (iy[None, :, None]
+          + (ir[None, None, :] + 0.5) / sr) * bin_w[:, None, None])
+    # full coordinate grid (R, S, sr, S, sr)
+    yy = ys[:, :, :, None, None]
+    xx = xs[:, None, None, :, :]
+    yy = jnp.broadcast_to(yy, ys.shape + (s, sr))
+    xx = jnp.broadcast_to(xx, (xs.shape[0], s, sr) + xs.shape[1:])
+    vals = _bilinear(features, yy, xx)           # (R, S, sr, S, sr, C)
+    return jnp.mean(vals, axis=(2, 4))           # (R, S, S, C)
+
+
+def multiscale_roi_align(
+    feature_pyramid: Dict[str, jax.Array],
+    rois: jax.Array,
+    output_size: int = 7,
+    canonical_level: int = 4,
+    canonical_scale: float = 224.0,
+    sampling_ratio: int = 2,
+    strides: Dict[str, int] | None = None,
+) -> jax.Array:
+    """FPN-aware RoIAlign (MultiScaleRoIAlign surface). feature_pyramid
+    maps 'p2'..'p5' → (H_l, W_l, C). Every roi is aligned on every level
+    then the assigned level is selected — static shapes, tiny grids."""
+    if strides is None:
+        strides = {k: 2 ** int(k[1]) for k in feature_pyramid}
+    areas = jnp.maximum(rois[:, 2] - rois[:, 0], 0) * \
+        jnp.maximum(rois[:, 3] - rois[:, 1], 0)
+    target = jnp.floor(canonical_level
+                       + jnp.log2(jnp.sqrt(areas) / canonical_scale + 1e-8))
+    levels = sorted(feature_pyramid, key=lambda k: int(k[1]))
+    lmin, lmax = int(levels[0][1]), int(levels[-1][1])
+    target = jnp.clip(target, lmin, lmax).astype(jnp.int32)
+
+    out = None
+    for name in levels:
+        lvl = int(name[1])
+        aligned = roi_align(feature_pyramid[name], rois, output_size,
+                            1.0 / strides[name], sampling_ratio)
+        sel = (target == lvl).astype(aligned.dtype)[:, None, None, None]
+        out = aligned * sel if out is None else out + aligned * sel
+    return out

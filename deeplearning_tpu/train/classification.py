@@ -19,7 +19,11 @@ from ..ops import losses
 from .state import TrainState
 
 
-def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False):
+def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False,
+                 aux_weight: float = 0.3):
+    """``aux_weight`` handles models returning (logits, aux_logits_tuple)
+    in train mode (GoogLeNet aux heads — the reference harness weighs the
+    aux CE by 0.3)."""
     def loss_fn(params: Any, state: TrainState, batch: Dict, rng: jax.Array
                 ) -> Tuple[jax.Array, Dict]:
         variables = state.variables(params)
@@ -31,6 +35,9 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False):
             aux["batch_stats"] = mutated["batch_stats"]
         else:
             logits = state.apply_fn(variables, batch["image"], **kwargs)
+        aux_logits = ()
+        if isinstance(logits, tuple):
+            logits, aux_logits = logits
         labels = batch["label"]
         if labels.ndim == logits.ndim:          # mixup soft targets
             loss = losses.soft_target_cross_entropy(logits, labels)
@@ -38,6 +45,10 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False):
         else:
             loss = losses.cross_entropy(logits, labels, label_smoothing)
             acc_labels = labels
+        for a in aux_logits:
+            if a is not None and labels.ndim < logits.ndim + 1:
+                loss = loss + aux_weight * losses.cross_entropy(
+                    a, acc_labels, label_smoothing)
         acc = jnp.mean((jnp.argmax(logits, -1) == acc_labels).astype(
             jnp.float32))
         aux["metrics"] = {"accuracy": acc}

@@ -1,0 +1,141 @@
+"""COCO/VOC evaluator tests: hand-computable cases + C++ == numpy parity."""
+
+import numpy as np
+import pytest
+
+from deeplearning_tpu.evaluation.coco_eval import CocoEvaluator
+from deeplearning_tpu.evaluation.voc import voc_ap, voc_eval_class
+
+
+def perfect_case(ev):
+    ev.add_image(0,
+                 gt_boxes=[[10, 10, 50, 50], [60, 60, 90, 90]],
+                 gt_labels=[0, 1],
+                 det_boxes=[[10, 10, 50, 50], [60, 60, 90, 90]],
+                 det_scores=[0.9, 0.8],
+                 det_labels=[0, 1])
+
+
+class TestCocoEvaluator:
+    def test_perfect_detections_ap1(self):
+        ev = CocoEvaluator(num_classes=2, use_cpp=False)
+        perfect_case(ev)
+        s = ev.summarize()
+        assert s["AP"] == pytest.approx(1.0)
+        assert s["AP50"] == pytest.approx(1.0)
+        assert s["AR100"] == pytest.approx(1.0)
+
+    def test_miss_and_false_positive(self):
+        ev = CocoEvaluator(num_classes=1, use_cpp=False)
+        ev.add_image(0,
+                     gt_boxes=[[10, 10, 50, 50], [100, 100, 150, 150]],
+                     gt_labels=[0, 0],
+                     det_boxes=[[10, 10, 50, 50], [200, 200, 220, 220]],
+                     det_scores=[0.9, 0.8],
+                     det_labels=[0, 0])
+        s = ev.summarize()
+        # one of two gts found at every threshold; one FP after the TP:
+        # precision envelope = [1.0 up to recall 0.5, 0 after] -> AP ~0.5
+        assert s["AP50"] == pytest.approx(0.5, abs=0.01)
+        assert s["AR100"] == pytest.approx(0.5)
+
+    def test_localization_quality_affects_high_iou_thresholds(self):
+        ev = CocoEvaluator(num_classes=1, use_cpp=False)
+        # det overlaps gt with IoU ~0.6: counts at 0.5/0.55/0.6 only
+        ev.add_image(0, gt_boxes=[[0, 0, 100, 100]], gt_labels=[0],
+                     det_boxes=[[0, 0, 100, 61.0]], det_scores=[0.9],
+                     det_labels=[0])
+        s = ev.summarize()
+        assert s["AP50"] == pytest.approx(1.0)
+        assert s["AP75"] == pytest.approx(0.0)
+        assert 0.2 < s["AP"] < 0.4
+
+    def test_crowd_gt_not_counted_and_matches_freely(self):
+        ev = CocoEvaluator(num_classes=1, use_cpp=False)
+        ev.add_image(0, gt_boxes=[[0, 0, 50, 50], [60, 0, 200, 50]],
+                     gt_labels=[0, 0], gt_crowd=[False, True],
+                     det_boxes=[[0, 0, 50, 50], [60, 0, 120, 50],
+                                [130, 0, 200, 50]],
+                     det_scores=[0.9, 0.8, 0.7], det_labels=[0, 0, 0])
+        s = ev.summarize()
+        # dets inside crowd are ignored (not FPs); the real gt is found
+        assert s["AP50"] == pytest.approx(1.0)
+
+    def test_area_ranges(self):
+        ev = CocoEvaluator(num_classes=1, use_cpp=False)
+        ev.add_image(0, gt_boxes=[[0, 0, 20, 20], [0, 0, 200, 200]],
+                     gt_labels=[0, 0],
+                     det_boxes=[[0, 0, 20, 20], [0, 0, 200, 200]],
+                     det_scores=[0.9, 0.8], det_labels=[0, 0])
+        s = ev.summarize()
+        assert s["AP_small"] == pytest.approx(1.0)   # 20x20 = 400 < 32²
+        assert s["AP_large"] == pytest.approx(1.0)
+        assert s["AP_medium"] == -1.0                # no medium gt
+
+
+class TestCppParity:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_cpp_matches_numpy(self, seed):
+        rng = np.random.default_rng(seed)
+
+        def rand_ev(use_cpp):
+            ev = CocoEvaluator(num_classes=3, use_cpp=use_cpp)
+            r = np.random.default_rng(seed)
+            for img in range(6):
+                ng, nd = r.integers(0, 6), r.integers(0, 12)
+                ctr = r.uniform(20, 200, (ng, 2))
+                wh = r.uniform(5, 80, (ng, 2))
+                gt = np.concatenate([ctr - wh / 2, ctr + wh / 2], axis=1)
+                ctr = r.uniform(20, 200, (nd, 2))
+                wh = r.uniform(5, 80, (nd, 2))
+                dt = np.concatenate([ctr - wh / 2, ctr + wh / 2], axis=1)
+                # make half the dets near-copies of gts for real matches
+                for i in range(min(ng, nd // 2)):
+                    dt[i] = gt[i] + r.normal(0, 3, 4)
+                ev.add_image(
+                    img, gt_boxes=gt, gt_labels=r.integers(0, 3, ng),
+                    gt_crowd=r.uniform(size=ng) < 0.15,
+                    det_boxes=dt, det_scores=r.uniform(0, 1, nd),
+                    det_labels=r.integers(0, 3, nd))
+            return ev
+
+        from deeplearning_tpu.native.build import load
+        if load("cocoeval") is None:
+            pytest.skip("g++ unavailable")
+        s_np = rand_ev(False).summarize()
+        s_cpp = rand_ev(True).summarize()
+        for k in s_np:
+            assert s_np[k] == pytest.approx(s_cpp[k], abs=1e-9), k
+
+
+class TestVocEval:
+    def test_ap_computation(self):
+        rec = np.asarray([0.5, 1.0])
+        prec = np.asarray([1.0, 0.66])
+        ap = voc_ap(rec, prec)
+        assert ap == pytest.approx(0.5 * 1.0 + 0.5 * 0.66, abs=1e-6)
+
+    def test_class_eval(self):
+        gt = {0: {"boxes": np.asarray([[0, 0, 10, 10.0]]),
+                  "difficult": np.asarray([False])},
+              1: {"boxes": np.asarray([[0, 0, 10, 10.0]]),
+                  "difficult": np.asarray([False])}}
+        dets = np.asarray([
+            [0, 0.9, 0, 0, 10, 10],     # TP
+            [1, 0.8, 0, 0, 10, 10],     # TP
+            [1, 0.7, 50, 50, 60, 60],   # FP
+        ])
+        res = voc_eval_class(gt, dets)
+        assert res["ap"] == pytest.approx(1.0)
+        # duplicate detection on same gt -> second is FP
+        dets2 = np.asarray([[0, 0.9, 0, 0, 10, 10],
+                            [0, 0.8, 0, 0, 10, 10]])
+        res2 = voc_eval_class(gt, dets2)
+        assert res2["recall"][-1] == pytest.approx(0.5)
+
+    def test_difficult_ignored(self):
+        gt = {0: {"boxes": np.asarray([[0, 0, 10, 10.0]]),
+                  "difficult": np.asarray([True])}}
+        dets = np.asarray([[0, 0.9, 0, 0, 10, 10]])
+        res = voc_eval_class(gt, dets)
+        assert res["ap"] == 0.0          # no positives to find

@@ -1,0 +1,237 @@
+"""Detection ops vs hand-rolled numpy golden references.
+
+The references implement torchvision's documented semantics (the ops the
+reference repo consumes: nms, roi_align, box coder), so parity here means
+parity with the reference's native ops (SURVEY.md §2.10.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.ops import anchors as anc
+from deeplearning_tpu.ops import boxes as B
+from deeplearning_tpu.ops import matcher as M
+from deeplearning_tpu.ops import nms as N
+from deeplearning_tpu.ops import roi_align as R
+
+
+# ---------------------------------------------------------------- golden
+def np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = (boxes[rest, 2] - boxes[rest, 0]) * \
+            (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / (a1 + a2 - inter + 1e-9)
+        order = rest[iou <= thresh]
+    return np.asarray(keep)
+
+
+def np_bilinear(feat, y, x):
+    h, w, _ = feat.shape
+    if y < -1 or y > h or x < -1 or x > w:
+        return np.zeros(feat.shape[-1])
+    y = min(max(y, 0), h - 1)
+    x = min(max(x, 0), w - 1)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+    ly, lx = y - y0, x - x0
+    return (feat[y0, x0] * (1 - ly) * (1 - lx) + feat[y0, x1] * (1 - ly) * lx
+            + feat[y1, x0] * ly * (1 - lx) + feat[y1, x1] * ly * lx)
+
+
+def np_roi_align(feat, roi, out_size, scale, sr):
+    x1, y1, x2, y2 = roi * scale
+    rw = max(x2 - x1, 1.0)
+    rh = max(y2 - y1, 1.0)
+    bw, bh = rw / out_size, rh / out_size
+    out = np.zeros((out_size, out_size, feat.shape[-1]))
+    for i in range(out_size):
+        for j in range(out_size):
+            acc = np.zeros(feat.shape[-1])
+            for si in range(sr):
+                for sj in range(sr):
+                    yy = y1 + (i + (si + 0.5) / sr) * bh
+                    xx = x1 + (j + (sj + 0.5) / sr) * bw
+                    acc += np_bilinear(feat, yy, xx)
+            out[i, j] = acc / (sr * sr)
+    return out
+
+
+# ----------------------------------------------------------------- tests
+class TestBoxOps:
+    def test_iou_matrix(self):
+        b1 = jnp.asarray([[0, 0, 10, 10], [5, 5, 15, 15]], jnp.float32)
+        b2 = jnp.asarray([[0, 0, 10, 10], [100, 100, 110, 110]], jnp.float32)
+        iou = B.box_iou(b1, b2)
+        np.testing.assert_allclose(np.asarray(iou),
+                                   [[1.0, 0.0], [25 / 175, 0.0]], atol=1e-6)
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        anchors = np.abs(rng.normal(50, 20, (32, 2)))
+        anchors = np.concatenate([anchors, anchors + np.abs(
+            rng.normal(30, 10, (32, 2))) + 1], axis=1).astype(np.float32)
+        gt = anchors + rng.normal(0, 3, anchors.shape).astype(np.float32)
+        gt[:, 2:] = np.maximum(gt[:, 2:], gt[:, :2] + 1)
+        deltas = B.encode_boxes(jnp.asarray(gt), jnp.asarray(anchors),
+                                weights=(10, 10, 5, 5))
+        back = B.decode_boxes(deltas, jnp.asarray(anchors),
+                              weights=(10, 10, 5, 5))
+        np.testing.assert_allclose(np.asarray(back), gt, atol=1e-3)
+
+    def test_elementwise_iou_kinds(self):
+        b1 = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        b2 = jnp.asarray([[5, 5, 15, 15]], jnp.float32)
+        iou = float(B.elementwise_box_iou(b1, b2, "iou")[0])
+        giou = float(B.elementwise_box_iou(b1, b2, "giou")[0])
+        ciou = float(B.elementwise_box_iou(b1, b2, "ciou")[0])
+        assert iou == pytest.approx(25 / 175, abs=1e-6)
+        assert giou < iou          # hull penalty
+        assert ciou < iou          # distance penalty
+        # identical boxes: all kinds == 1
+        same = float(B.elementwise_box_iou(b1, b1, "ciou")[0])
+        assert same == pytest.approx(1.0, abs=1e-6)
+
+    def test_clip_and_small_mask(self):
+        boxes = jnp.asarray([[-5, -5, 20, 20], [0, 0, 0.5, 8]], jnp.float32)
+        clipped = B.clip_boxes(boxes, (10, 12))
+        np.testing.assert_allclose(np.asarray(clipped),
+                                   [[0, 0, 12, 10], [0, 0, 0.5, 8]])
+        mask = B.remove_small_boxes_mask(clipped, 1.0)
+        np.testing.assert_array_equal(np.asarray(mask), [True, False])
+
+
+class TestNMS:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_numpy_greedy(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 60
+        ctr = rng.uniform(10, 90, (n, 2))
+        wh = rng.uniform(5, 30, (n, 2))
+        boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2],
+                               axis=1).astype(np.float32)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        ref = np_nms(boxes, scores, 0.5)
+        idx, valid = jax.jit(
+            lambda b, s: N.nms(b, s, 0.5, max_out=n))(
+            jnp.asarray(boxes), jnp.asarray(scores))
+        got = np.asarray(idx)[np.asarray(valid)]
+        np.testing.assert_array_equal(got, ref)
+
+    def test_max_out_truncates(self):
+        boxes = jnp.asarray([[i * 20, 0, i * 20 + 10, 10] for i in range(8)],
+                            jnp.float32)
+        scores = jnp.asarray(np.linspace(0.9, 0.2, 8), jnp.float32)
+        idx, valid = N.nms(boxes, scores, 0.5, max_out=3)
+        assert int(valid.sum()) == 3
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, 2])
+
+    def test_batched_nms_classes_dont_suppress(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8])
+        classes = jnp.asarray([0, 1])
+        _, valid = N.batched_nms(boxes, scores, classes, 0.3, max_out=2)
+        assert int(valid.sum()) == 2          # same box, different class
+        _, valid_same = N.nms(boxes, scores, 0.3, max_out=2)
+        assert int(valid_same.sum()) == 1
+
+    def test_score_threshold(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.01])
+        _, valid = N.nms(boxes, scores, 0.5, max_out=2, score_threshold=0.1)
+        assert int(valid.sum()) == 1
+
+
+class TestRoIAlign:
+    @pytest.mark.parametrize("aligned", [False])
+    def test_matches_numpy(self, aligned):
+        rng = np.random.default_rng(0)
+        feat = rng.normal(0, 1, (16, 16, 3)).astype(np.float32)
+        rois = np.asarray([[2.0, 2.0, 10.0, 12.0], [0.0, 0.0, 32.0, 32.0]],
+                          np.float32)
+        out = R.roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                          output_size=5, spatial_scale=0.5,
+                          sampling_ratio=2)
+        for r in range(2):
+            ref = np_roi_align(feat, rois[r], 5, 0.5, 2)
+            np.testing.assert_allclose(np.asarray(out[r]), ref, atol=1e-4)
+
+    def test_multiscale_level_assignment(self):
+        rng = np.random.default_rng(0)
+        pyramid = {f"p{l}": jnp.asarray(
+            rng.normal(0, 1, (64 // 2 ** (l - 2), 64 // 2 ** (l - 2), 4)),
+            jnp.float32) for l in (2, 3, 4, 5)}
+        rois = jnp.asarray([
+            [0, 0, 32, 32],          # small → p2
+            [0, 0, 224, 224],        # canonical → p4
+            [0, 0, 500, 500],        # large → p5
+        ], jnp.float32)
+        out = R.multiscale_roi_align(pyramid, rois, output_size=7)
+        assert out.shape == (3, 7, 7, 4)
+        # small roi must equal direct p2 align
+        direct = R.roi_align(pyramid["p2"], rois[:1], 7, 1 / 4, 2)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(direct[0]),
+                                   atol=1e-5)
+
+
+class TestMatcherSampler:
+    def test_matcher_categories(self):
+        gt = jnp.asarray([[0, 0, 10, 10], [50, 50, 60, 60], [0, 0, 0, 0]],
+                         jnp.float32)
+        valid = jnp.asarray([True, True, False])
+        anchors = jnp.asarray([
+            [0, 0, 10, 10],        # IoU 1.0 with gt0 -> match 0
+            [0, 0, 14, 10],        # IoU ~0.71 -> match 0
+            [2, 2, 12, 12],        # IoU ~0.47 with gt0 -> between
+            [48, 48, 54, 54],      # IoU ~0.1 with gt1 -> below, but best
+        ], jnp.float32)
+        iou = B.box_iou(gt, anchors)
+        m = M.match_anchors(iou, valid, 0.7, 0.3, allow_low_quality=False)
+        assert int(m[0]) == 0 and int(m[1]) == 0
+        assert int(m[2]) == M.BETWEEN
+        assert int(m[3]) == M.BELOW_LOW
+        forced = M.match_anchors(iou, valid, 0.7, 0.3,
+                                 allow_low_quality=True)
+        assert int(forced[3]) == 1           # gt1's best anchor forced in
+
+    def test_balanced_sampler_counts(self):
+        matches = jnp.asarray([0] * 10 + [M.BELOW_LOW] * 100
+                              + [M.BETWEEN] * 5)
+        pos, neg = M.balanced_sample(matches, jax.random.key(0),
+                                     batch_size_per_image=64,
+                                     positive_fraction=0.25)
+        assert int(pos.sum()) == 10            # only 10 available (<16)
+        assert int(neg.sum()) == 54            # fills to 64
+        assert not bool((pos & neg).any())
+        # between-category anchors never sampled
+        assert not bool(pos[110:].any()) and not bool(neg[110:].any())
+
+
+class TestAnchors:
+    def test_grid_counts_and_coverage(self):
+        shapes = {"p3": (8, 8), "p4": (4, 4)}
+        strides = {"p3": 8, "p4": 16}
+        sizes = {"p3": (32,), "p4": (64,)}
+        all_anchors, counts = anc.pyramid_anchors(shapes, strides, sizes,
+                                                  ratios=(1.0,))
+        assert counts == [64, 16]
+        assert all_anchors.shape == (80, 4)
+        # first p3 anchor centered at (0,0) with size 32
+        np.testing.assert_allclose(all_anchors[0], [-16, -16, 16, 16])
+        # retinanet sizes helper
+        s = anc.retinanet_sizes()
+        assert set(s) == {"p3", "p4", "p5", "p6", "p7"}
+        assert s["p3"][0] == pytest.approx(32)
