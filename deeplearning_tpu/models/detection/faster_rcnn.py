@@ -1,0 +1,330 @@
+"""Faster R-CNN: two-stage detector with RPN + RoI heads on ResNet-FPN.
+
+Surface of detection/fasterRcnn: FasterRCNNBase.forward
+(models/faster_rcnn.py:44: backbone→rpn→roi_heads→postprocess),
+TwoMLPHead (:115), FastRCNNPredictor (:138), RegionProposalNetwork
+(models/rpn_function.py:304) with RPNHead (:207) and AnchorsGenerator
+(:25), RoIHeads (models/roi_head.py:57) with fastrcnn_loss (:11),
+Matcher/BalancedPositiveNegativeSampler/BoxCoder (utils/det_utils.py),
+MultiScaleRoIAlign (faster_rcnn.py:305 → ops/roi_align.py).
+
+TPU-first reformulation — every stage is fixed-shape:
+- proposals: per-level top-k (static k) → concat → NMS to a fixed
+  ``post_nms_top_n`` with a validity mask; suppressed slots carry zeros.
+- training sampling: exact-count random masks (ops/matcher.balanced_sample)
+  computed over ALL proposals; losses are mask-weighted sums — no gather
+  to a dynamic subset. (FLOP cost of scoring unsampled rois is trivial
+  next to the backbone.)
+- gt boxes ride along padded (MAX_GT) with validity masks.
+The image transform (resize/pad, transform.py:70) lives in the data
+pipeline: the model consumes fixed-size batches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ...ops import anchors as anc
+from ...ops import boxes as box_ops
+from ...ops import losses as L
+from ...ops import matcher as M
+from ...ops import nms as nms_ops
+from ...ops.roi_align import multiscale_roi_align
+from ..classification.resnet import ResNet
+from .fpn import FPN
+
+
+class RPNHead(nn.Module):
+    anchors_per_loc: int = 3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(x.shape[-1], (3, 3), padding="SAME", dtype=self.dtype,
+                    kernel_init=nn.initializers.normal(0.01),
+                    name="conv")(x)
+        x = nn.relu(x)
+        obj = nn.Conv(self.anchors_per_loc, (1, 1), dtype=self.dtype,
+                      kernel_init=nn.initializers.normal(0.01),
+                      name="objectness")(x)
+        deltas = nn.Conv(4 * self.anchors_per_loc, (1, 1), dtype=self.dtype,
+                         kernel_init=nn.initializers.normal(0.01),
+                         name="deltas")(x)
+        b = x.shape[0]
+        return (obj.reshape(b, -1).astype(jnp.float32),
+                deltas.reshape(b, -1, 4).astype(jnp.float32))
+
+
+class TwoMLPHead(nn.Module):
+    hidden: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc7")(x))
+        return x
+
+
+class FastRCNNPredictor(nn.Module):
+    num_classes: int               # including background class 0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scores = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="cls_score")(x)
+        deltas = nn.Dense(4 * self.num_classes, dtype=self.dtype,
+                          name="bbox_pred")(x)
+        return scores.astype(jnp.float32), deltas.reshape(
+            x.shape[0], self.num_classes, 4).astype(jnp.float32)
+
+
+class FasterRCNN(nn.Module):
+    """Forward returns raw heads; ``generate_proposals``/losses/postprocess
+    are pure functions below so training and inference wire them freely."""
+    num_classes: int = 21          # incl. background
+    backbone_sizes: Sequence[int] = (3, 4, 6, 3)
+    fpn_channels: int = 256
+    anchors_per_loc: int = 3
+    roi_output_size: int = 7
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images: jax.Array, proposals: Optional[jax.Array]
+                 = None, train: bool = False) -> Dict[str, Any]:
+        feats = ResNet(stage_sizes=self.backbone_sizes,
+                       return_features=True, dtype=self.dtype,
+                       name="backbone")(images, train=train)
+        pyramid = FPN(self.fpn_channels, extra_levels="pool",
+                      dtype=self.dtype, name="fpn")(feats)
+        rpn_head = RPNHead(self.anchors_per_loc, self.dtype, name="rpn")
+        obj, deltas = [], []
+        level_counts = []
+        for name in sorted(pyramid, key=lambda k: int(k[1:])):
+            o, d = rpn_head(pyramid[name])
+            obj.append(o)
+            deltas.append(d)
+            level_counts.append(o.shape[1])
+        out = {
+            "pyramid": pyramid,
+            "rpn_obj": jnp.concatenate(obj, axis=1),
+            "rpn_deltas": jnp.concatenate(deltas, axis=1),
+            "level_counts": level_counts,
+        }
+        # second stage always runs (on a dummy roi when no proposals are
+        # given) so the box-head params exist under eval-mode init
+        run_props = proposals if proposals is not None else \
+            jnp.zeros((images.shape[0], 1, 4), jnp.float32)
+        # roi-align over p2..p5 (the pooled p6 extra level is RPN-only,
+        # faster_rcnn.py:305 semantics)
+        align_levels = sorted(pyramid, key=lambda k: int(k[1:]))[:-1]
+
+        def roi_one(i):
+            pyr_slice = {k: pyramid[k][i] for k in align_levels}
+            return multiscale_roi_align(
+                pyr_slice, run_props[i], self.roi_output_size,
+                strides={k: 2 ** int(k[1]) for k in align_levels})
+
+        roi_feats = jax.vmap(roi_one)(jnp.arange(images.shape[0]))
+        b, p = run_props.shape[:2]
+        roi_feats = roi_feats.reshape(b * p, self.roi_output_size,
+                                      self.roi_output_size,
+                                      self.fpn_channels)
+        h = TwoMLPHead(dtype=self.dtype, name="box_head")(
+            roi_feats.astype(self.dtype))
+        scores, box_deltas = FastRCNNPredictor(
+            self.num_classes, self.dtype, name="box_predictor")(h)
+        if proposals is not None:
+            out["roi_scores"] = scores.reshape(b, p, self.num_classes)
+            out["roi_deltas"] = box_deltas.reshape(b, p, self.num_classes, 4)
+        return out
+
+
+# ---------------------------------------------------------------- anchors
+def fasterrcnn_anchors(image_hw: Tuple[int, int]) -> np.ndarray:
+    """FPN anchors: one size per level ((32..512) × 3 ratios) on p2..p6."""
+    h, w = image_hw
+    shapes = {f"p{l}": (math.ceil(h / 2 ** l), math.ceil(w / 2 ** l))
+              for l in (2, 3, 4, 5, 6)}
+    strides = {k: 2 ** int(k[1]) for k in shapes}
+    sizes = {f"p{l}": (2 ** (l + 3),) for l in (2, 3, 4, 5, 6)}
+    all_anchors, _ = anc.pyramid_anchors(shapes, strides, sizes)
+    return all_anchors
+
+
+# -------------------------------------------------------------- proposals
+def generate_proposals(outputs: Dict, anchors: jax.Array,
+                       image_hw: Tuple[int, int],
+                       pre_nms_top_n: int = 1000,
+                       post_nms_top_n: int = 256,
+                       nms_thresh: float = 0.7,
+                       min_size: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+    """(B, post_nms_top_n, 4) proposals + validity. Per-level pre-NMS
+    top-k then joint NMS (rpn_function.py filter_proposals surface)."""
+    level_counts = outputs["level_counts"]
+
+    def per_image(obj, deltas):
+        boxes = box_ops.decode_boxes(deltas, anchors)
+        boxes = box_ops.clip_boxes(boxes, image_hw)
+        valid = box_ops.remove_small_boxes_mask(boxes, min_size)
+        scores = jnp.where(valid, obj, -1e9)
+        # per-level top-k
+        sel_boxes, sel_scores = [], []
+        start = 0
+        for count in level_counts:
+            k = min(pre_nms_top_n, count)
+            s_lvl = jax.lax.dynamic_slice_in_dim(scores, start, count)
+            b_lvl = jax.lax.dynamic_slice_in_dim(boxes, start, count)
+            top_s, top_i = jax.lax.top_k(s_lvl, k)
+            sel_boxes.append(b_lvl[top_i])
+            sel_scores.append(top_s)
+            start += count
+        cand_boxes = jnp.concatenate(sel_boxes, axis=0)
+        cand_scores = jnp.concatenate(sel_scores, axis=0)
+        keep_idx, keep_valid = nms_ops.nms(cand_boxes, cand_scores,
+                                           nms_thresh, post_nms_top_n,
+                                           score_threshold=-1e8)
+        props, = nms_ops.gather_nms_outputs(keep_idx, keep_valid, cand_boxes)
+        return props, keep_valid
+
+    return jax.vmap(per_image)(outputs["rpn_obj"], outputs["rpn_deltas"])
+
+
+# ----------------------------------------------------------------- losses
+def rpn_loss(outputs: Dict, anchors: jax.Array, gt_boxes: jax.Array,
+             gt_valid: jax.Array, rng: jax.Array,
+             batch_per_image: int = 256, positive_fraction: float = 0.5
+             ) -> Dict[str, jax.Array]:
+    def per_image(obj, deltas, boxes, valid, key):
+        iou = box_ops.box_iou(boxes, anchors)
+        matches = M.match_anchors(iou, valid, 0.7, 0.3,
+                                  allow_low_quality=True)
+        pos, neg = M.balanced_sample(matches, key, batch_per_image,
+                                     positive_fraction)
+        labels = (matches >= 0).astype(jnp.float32)
+        sample = pos | neg
+        obj_loss = L.binary_cross_entropy(obj, labels, weights=sample)
+        safe = jnp.maximum(matches, 0)
+        reg_targets = box_ops.encode_boxes(boxes[safe], anchors)
+        reg_loss = L.smooth_l1(deltas, reg_targets, beta=1.0 / 9,
+                               reduction="none")
+        reg_loss = jnp.sum(reg_loss * pos[:, None]) / jnp.maximum(
+            jnp.sum(sample), 1)
+        return obj_loss, reg_loss
+
+    keys = jax.random.split(rng, gt_boxes.shape[0])
+    obj_l, reg_l = jax.vmap(per_image)(
+        outputs["rpn_obj"], outputs["rpn_deltas"], gt_boxes, gt_valid, keys)
+    return {"rpn_obj_loss": jnp.mean(obj_l),
+            "rpn_reg_loss": jnp.mean(reg_l)}
+
+
+def sample_rois(proposals: jax.Array, prop_valid: jax.Array,
+                gt_boxes: jax.Array, gt_labels: jax.Array,
+                gt_valid: jax.Array, rng: jax.Array,
+                batch_per_image: int = 128, positive_fraction: float = 0.25
+                ) -> Dict[str, jax.Array]:
+    """Append gt to proposals (roi_head.py add_gt_boxes), match at 0.5,
+    build per-roi cls/reg targets + sampled weight masks."""
+    def per_image(props, pvalid, boxes, labels, valid, key):
+        all_props = jnp.concatenate([props, boxes], axis=0)
+        all_valid = jnp.concatenate([pvalid, valid], axis=0)
+        iou = box_ops.box_iou(boxes, all_props)
+        iou = jnp.where(all_valid[None, :], iou, -1.0)
+        matches = M.match_anchors(iou, valid, 0.5, 0.5,
+                                  allow_low_quality=False)
+        # padded proposal slots must not be sampled as negatives: mark
+        # them ignore (BETWEEN) so balanced_sample skips them
+        matches = jnp.where(all_valid, matches, M.BETWEEN)
+        pos, neg = M.balanced_sample(matches, key, batch_per_image,
+                                     positive_fraction)
+        safe = jnp.maximum(matches, 0)
+        cls_target = jnp.where(matches >= 0, labels[safe], 0)  # 0 = bg
+        reg_target = box_ops.encode_boxes(boxes[safe], all_props,
+                                          weights=(10, 10, 5, 5))
+        return {"rois": all_props, "cls_target": cls_target,
+                "reg_target": reg_target, "pos": pos, "sample": pos | neg}
+
+    keys = jax.random.split(rng, proposals.shape[0])
+    return jax.vmap(per_image)(proposals, prop_valid, gt_boxes, gt_labels,
+                               gt_valid, keys)
+
+
+def roi_head_loss(roi_scores: jax.Array, roi_deltas: jax.Array,
+                  samples: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """fastrcnn_loss (roi_head.py:11): CE over sampled rois + smooth-L1 on
+    positives' matched-class deltas."""
+    def per_image(scores, deltas, cls_t, reg_t, pos, sample):
+        cls_loss = L.cross_entropy(scores, cls_t, weights=sample)
+        per_class = jnp.take_along_axis(
+            deltas, cls_t[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        reg = L.smooth_l1(per_class, reg_t, beta=1.0, reduction="none")
+        reg_loss = jnp.sum(reg * pos[:, None]) / jnp.maximum(
+            jnp.sum(sample), 1)
+        return cls_loss, reg_loss
+
+    cls_l, reg_l = jax.vmap(per_image)(
+        roi_scores, roi_deltas, samples["cls_target"],
+        samples["reg_target"], samples["pos"], samples["sample"])
+    return {"roi_cls_loss": jnp.mean(cls_l),
+            "roi_reg_loss": jnp.mean(reg_l)}
+
+
+def fasterrcnn_postprocess(roi_scores: jax.Array, roi_deltas: jax.Array,
+                           proposals: jax.Array, image_hw: Tuple[int, int],
+                           prop_valid: Optional[jax.Array] = None,
+                           score_thresh: float = 0.05,
+                           nms_thresh: float = 0.5,
+                           max_det: int = 100) -> Dict[str, jax.Array]:
+    """Softmax → per-class decode → class-aware NMS → fixed max_det
+    (roi_head.py:295-326 postprocess_detections surface). ``prop_valid``
+    masks padded proposal slots out of the candidate pool (zero-area
+    padded boxes do not suppress each other in NMS, so they MUST be
+    masked here)."""
+    num_classes = roi_scores.shape[-1]
+    if prop_valid is None:
+        prop_valid = jnp.ones(proposals.shape[:2], bool)
+
+    def per_image(scores, deltas, props, pvalid):
+        probs = jax.nn.softmax(scores, axis=-1)          # (P, C)
+        p = props.shape[0]
+        # expand (P, C-1) foreground candidates; invalid slots -> -inf
+        fg_probs = jnp.where(pvalid[:, None], probs[:, 1:],
+                             -jnp.inf).reshape(-1)
+        classes = jnp.tile(jnp.arange(1, num_classes), p)
+        boxes = box_ops.decode_boxes(
+            deltas[:, 1:].reshape(-1, 4),
+            jnp.repeat(props, num_classes - 1, axis=0),
+            weights=(10, 10, 5, 5))
+        boxes = box_ops.clip_boxes(boxes, image_hw)
+        keep_idx, keep_valid = nms_ops.batched_nms(
+            boxes, fg_probs, classes, nms_thresh, max_det,
+            score_threshold=score_thresh)
+        out_boxes, out_scores, out_classes = nms_ops.gather_nms_outputs(
+            keep_idx, keep_valid, boxes, fg_probs, classes)
+        return out_boxes, out_scores, out_classes, keep_valid
+
+    boxes, scores, classes, valid = jax.vmap(per_image)(
+        roi_scores, roi_deltas, proposals, prop_valid)
+    return {"boxes": boxes, "scores": scores, "labels": classes,
+            "valid": valid}
+
+
+@MODELS.register("fasterrcnn_resnet50_fpn")
+def fasterrcnn_resnet50_fpn(num_classes: int = 21, **kw):
+    return FasterRCNN(num_classes=num_classes, **kw)
+
+
+@MODELS.register("fasterrcnn_resnet18_fpn")
+def fasterrcnn_resnet18_fpn(num_classes: int = 21, **kw):
+    return FasterRCNN(num_classes=num_classes,
+                      backbone_sizes=(2, 2, 2, 2), **kw)

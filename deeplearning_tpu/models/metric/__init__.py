@@ -1,0 +1,1 @@
+from . import bdb  # noqa: F401

@@ -1,0 +1,122 @@
+"""Batch-DropBlock (BDB) re-ID network + ArcFace retrieval model.
+
+Surface of metric_learning/BDB (models/networks.py — ResNet50 trunk with a
+global branch and a part branch whose feature map gets a fixed-size block
+dropped per batch, trained with triplet+softmax, trainers/trainer.py:35)
+and metric_learning/Happy-Whale retrieval (models/model.py:11 model_whale:
+backbone + BNNeck embedding + ArcFace/wnfc classifier — see
+ops/losses.arcface_logits; getLoss :154 combines triplet(global) +
+triplet(local) + CE).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from ..classification.resnet import ResNet
+
+
+def batch_drop_block(x: jax.Array, rng: jax.Array, h_ratio: float,
+                     w_ratio: float) -> jax.Array:
+    """Zero one identical (rh, rw) block across the whole batch — the BDB
+    regularizer (networks.py BatchDrop). Fixed block size => static shapes;
+    the random position is a traced scalar."""
+    b, h, w, c = x.shape
+    rh = max(int(round(h * h_ratio)), 1)
+    rw = max(int(round(w * w_ratio)), 1)
+    ky, kx = jax.random.split(rng)
+    y0 = jax.random.randint(ky, (), 0, h - rh + 1)
+    x0 = jax.random.randint(kx, (), 0, w - rw + 1)
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    block = ((rows >= y0) & (rows < y0 + rh)
+             & (cols >= x0) & (cols < x0 + rw))
+    return x * (1.0 - block[None, :, :, None].astype(x.dtype))
+
+
+class BDBNetwork(nn.Module):
+    num_classes: int
+    feat_dim: int = 512
+    drop_height_ratio: float = 0.33
+    drop_width_ratio: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats = ResNet(stage_sizes=(3, 4, 6, 3), return_features=True,
+                       dtype=self.dtype, name="backbone")(x, train=train)
+        fmap = feats["c5"]
+
+        # global branch: GAP -> embedding -> classifier
+        g = jnp.mean(fmap.astype(jnp.float32), axis=(1, 2))
+        g_emb = nn.Dense(self.feat_dim, use_bias=False, dtype=self.dtype,
+                         name="global_reduce")(g.astype(self.dtype))
+        g_emb = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name="global_bn")(g_emb)
+        g_logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                            name="global_cls")(g_emb).astype(jnp.float32)
+
+        # part branch: extra bottleneck conv, batch-drop, GMP
+        p = nn.Conv(1024, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="part_conv")(fmap)
+        p = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="part_conv_bn")(p)
+        p = nn.relu(p)
+        if train:
+            p = batch_drop_block(p, self.make_rng("dropout"),
+                                 self.drop_height_ratio,
+                                 self.drop_width_ratio)
+        p_feat = jnp.max(p.astype(jnp.float32), axis=(1, 2))
+        p_emb = nn.Dense(1024, use_bias=False, dtype=self.dtype,
+                         name="part_reduce")(p_feat.astype(self.dtype))
+        p_emb = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name="part_bn")(p_emb)
+        p_logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                            name="part_cls")(p_emb).astype(jnp.float32)
+
+        embedding = jnp.concatenate(
+            [g_emb.astype(jnp.float32), p_emb.astype(jnp.float32)], axis=-1)
+        return {"embedding": embedding,
+                "global_embedding": g_emb.astype(jnp.float32),
+                "part_embedding": p_emb.astype(jnp.float32),
+                "global_logits": g_logits, "part_logits": p_logits}
+
+
+class ArcFaceModel(nn.Module):
+    """Backbone + BNNeck embedding + ArcFace class centers (Happy-Whale
+    retrieval surface). Use ops/losses.arcface_logits(embedding, centers,
+    labels) for the margin loss."""
+    num_classes: int
+    feat_dim: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats = ResNet(stage_sizes=(2, 2, 2, 2), block="basic",
+                       return_features=True, dtype=self.dtype,
+                       name="backbone")(x, train=train)
+        h = jnp.mean(feats["c5"].astype(jnp.float32), axis=(1, 2))
+        emb = nn.Dense(self.feat_dim, use_bias=False, dtype=self.dtype,
+                       name="neck")(h.astype(self.dtype))
+        emb = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                           dtype=self.dtype, name="neck_bn")(emb)
+        emb = emb.astype(jnp.float32)
+        centers = self.param("arcface_centers",
+                             nn.initializers.normal(0.01),
+                             (self.feat_dim, self.num_classes), jnp.float32)
+        return {"embedding": emb, "centers": centers}
+
+
+@MODELS.register("bdb_resnet50")
+def bdb_resnet50(num_classes: int = 751, **kw):
+    return BDBNetwork(num_classes=num_classes, **kw)
+
+
+@MODELS.register("arcface_resnet18")
+def arcface_resnet18(num_classes: int = 100, **kw):
+    return ArcFaceModel(num_classes=num_classes, **kw)

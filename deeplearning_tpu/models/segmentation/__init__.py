@@ -1,0 +1,1 @@
+from . import fcn_deeplab, hrnet, unet  # noqa: F401

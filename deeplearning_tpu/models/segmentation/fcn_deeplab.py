@@ -1,0 +1,148 @@
+"""FCN-ResNet and DeepLabV3/V3+ semantic segmentation heads.
+
+Surface of Image_segmentation/FCN (FCN-ResNet50 with aux head,
+utils/train_and_eval.py:6 main+aux CE), DeepLabV3 (models/deeplabv3.py
+ASPP over dilated ResNet) and DeepLabV3Plus (encoder-decoder with
+low-level feature fusion). The backbone is the shared ResNet in dilated
+mode (output stride 8/16 via dilation instead of stride, the standard
+segmentation trick).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from ..classification.resnet import ResNet
+
+
+class FCNHead(nn.Module):
+    channels: int
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        return nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                       name="cls")(x)
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling (deeplabv3 surface)."""
+    channels: int = 256
+    rates: Sequence[int] = (12, 24, 36)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        branches = []
+        y = nn.Conv(self.channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="b0")(x)
+        branches.append(nn.relu(norm(name="b0_bn")(y)))
+        for i, r in enumerate(self.rates):
+            y = nn.Conv(self.channels, (3, 3), padding="SAME",
+                        kernel_dilation=(r, r), use_bias=False,
+                        dtype=self.dtype, name=f"b{i + 1}")(x)
+            branches.append(nn.relu(norm(name=f"b{i + 1}_bn")(y)))
+        # image-level pooling branch
+        b, h, w, c = x.shape
+        g = jnp.mean(x, axis=(1, 2), keepdims=True)
+        g = nn.Conv(self.channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="pool")(g)
+        g = nn.relu(norm(name="pool_bn")(g))
+        g = jnp.broadcast_to(g, (b, h, w, self.channels))
+        branches.append(g)
+        y = jnp.concatenate(branches, axis=-1)
+        y = nn.Conv(self.channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="project")(y)
+        y = nn.relu(norm(name="project_bn")(y))
+        return nn.Dropout(0.5, deterministic=not train)(y)
+
+
+class SegModel(nn.Module):
+    """Backbone + head with logits upsampled to input size; optional aux
+    head from c4 (FCN aux surface)."""
+    num_classes: int
+    head: str = "fcn"               # 'fcn' | 'deeplabv3' | 'deeplabv3plus'
+    backbone_sizes: Sequence[int] = (3, 4, 6, 3)
+    aux: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, h, w, _ = x.shape
+        feats = ResNet(stage_sizes=self.backbone_sizes,
+                       return_features=True, dtype=self.dtype,
+                       name="backbone")(x, train=train)
+        c4, c5 = feats["c4"], feats["c5"]
+        if self.head == "fcn":
+            logits = FCNHead(512, self.num_classes, self.dtype,
+                             name="head")(c5, train)
+        elif self.head == "deeplabv3":
+            y = ASPP(dtype=self.dtype, name="aspp")(c5, train)
+            logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                             name="cls")(y)
+        elif self.head == "deeplabv3plus":
+            y = ASPP(dtype=self.dtype, name="aspp")(c5, train)
+            yb, yh, yw, yc = y.shape
+            low = feats["c2"]
+            lb, lh, lw, lc = low.shape
+            y = jax.image.resize(y, (yb, lh, lw, yc), "bilinear")
+            low = nn.Conv(48, (1, 1), use_bias=False, dtype=self.dtype,
+                          name="low_proj")(low)
+            low = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                               dtype=self.dtype, name="low_bn")(low)
+            low = nn.relu(low)
+            y = jnp.concatenate([y, low], axis=-1)
+            y = nn.Conv(256, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name="fuse")(y)
+            y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name="fuse_bn")(y)
+            y = nn.relu(y)
+            logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                             name="cls")(y)
+        else:
+            raise ValueError(self.head)
+        logits = jax.image.resize(
+            logits.astype(jnp.float32), (b, h, w, self.num_classes),
+            "bilinear")
+        if self.aux and train:
+            aux_logits = FCNHead(256, self.num_classes, self.dtype,
+                                 name="aux_head")(c4, train)
+            aux_logits = jax.image.resize(
+                aux_logits.astype(jnp.float32),
+                (b, h, w, self.num_classes), "bilinear")
+            return logits, aux_logits
+        if self.aux:
+            # params must exist under eval-mode init (harness convention)
+            FCNHead(256, self.num_classes, self.dtype,
+                    name="aux_head")(c4, train)
+        return logits
+
+
+@MODELS.register("fcn_resnet50")
+def fcn_resnet50(num_classes: int = 21, **kw):
+    return SegModel(num_classes=num_classes, head="fcn", **kw)
+
+
+@MODELS.register("deeplabv3_resnet50")
+def deeplabv3_resnet50(num_classes: int = 21, **kw):
+    return SegModel(num_classes=num_classes, head="deeplabv3", **kw)
+
+
+@MODELS.register("deeplabv3plus_resnet50")
+def deeplabv3plus_resnet50(num_classes: int = 21, **kw):
+    return SegModel(num_classes=num_classes, head="deeplabv3plus", **kw)

@@ -1,0 +1,156 @@
+"""HRNet: parallel multi-resolution streams with cross-resolution fusion.
+
+Surface of Image_segmentation/HR-Net-Seg (models/seg_hrnet.py HRNet-W18/48)
+and the pose_estimation/Insulator backbone (models/hrnet.py) — the same
+trunk serves segmentation (concat-upsampled head) and keypoint heatmaps
+(K-channel head), selected by ``head``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    relu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (self.kernel,) * 2,
+                    strides=(self.stride,) * 2, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn")(x)
+        return nn.relu(x) if self.relu else x
+
+
+class BasicResBlock(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = ConvBN(self.features, dtype=self.dtype, name="c1")(x, train)
+        y = ConvBN(self.features, relu=False, dtype=self.dtype,
+                   name="c2")(y, train)
+        if x.shape[-1] != self.features:
+            x = ConvBN(self.features, kernel=1, relu=False,
+                       dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(x + y)
+
+
+class FuseLayer(nn.Module):
+    """Exchange info across resolution streams: down via strided conv,
+    up via 1x1 + bilinear resize."""
+    widths: Sequence[int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, streams, train: bool = False):
+        n = len(streams)
+        outs = []
+        for i in range(n):
+            acc = None
+            for j in range(n):
+                y = streams[j]
+                if j > i:        # upsample j -> i
+                    y = ConvBN(self.widths[i], kernel=1, relu=False,
+                               dtype=self.dtype, name=f"up{j}to{i}")(
+                        y, train)
+                    b, h, w, c = streams[i].shape
+                    y = jax.image.resize(y, (b, h, w, c), "bilinear")
+                elif j < i:      # downsample j -> i by repeated stride-2
+                    for k in range(i - j):
+                        last = k == i - j - 1
+                        y = ConvBN(self.widths[i] if last
+                                   else self.widths[j], stride=2,
+                                   relu=not last, dtype=self.dtype,
+                                   name=f"down{j}to{i}_{k}")(y, train)
+                acc = y if acc is None else acc + y
+            outs.append(nn.relu(acc))
+        return outs
+
+
+class HRNet(nn.Module):
+    num_classes: int = 19
+    base_width: int = 18            # W18; W48 for the large variant
+    head: str = "seg"               # 'seg' | 'keypoints' | 'features'
+    blocks_per_stage: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.base_width
+        widths = [w, w * 2, w * 4, w * 8]
+        in_h, in_w = x.shape[1:3]
+        x = x.astype(self.dtype)
+        x = ConvBN(64, stride=2, dtype=self.dtype, name="stem1")(x, train)
+        x = ConvBN(64, stride=2, dtype=self.dtype, name="stem2")(x, train)
+
+        streams = [x]
+        for stage in range(4):
+            # add a new lower-resolution stream
+            if stage > 0:
+                streams.append(ConvBN(widths[stage], stride=2,
+                                      dtype=self.dtype,
+                                      name=f"trans{stage}")(
+                    streams[-1], train))
+            # width-align + residual blocks per stream
+            new_streams = []
+            for si, s in enumerate(streams):
+                for bi in range(self.blocks_per_stage):
+                    s = BasicResBlock(widths[si], self.dtype,
+                                      name=f"s{stage}_r{si}_b{bi}")(s, train)
+                new_streams.append(s)
+            streams = new_streams
+            if stage > 0:
+                streams = FuseLayer(widths[:len(streams)], self.dtype,
+                                    name=f"fuse{stage}")(streams, train)
+
+        if self.head == "features":
+            return streams
+        # upsample all to the highest resolution and concat
+        b, h, wd, _ = streams[0].shape
+        ups = [streams[0]]
+        for s in streams[1:]:
+            ups.append(jax.image.resize(
+                s, (b, h, wd, s.shape[-1]), "bilinear"))
+        y = jnp.concatenate(ups, axis=-1)
+        y = ConvBN(sum(widths), kernel=1, dtype=self.dtype,
+                   name="head_conv")(y, train)
+        y = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                    name="cls")(y)
+        if self.head == "seg":
+            y = jax.image.resize(y.astype(jnp.float32),
+                                 (b, in_h, in_w, self.num_classes),
+                                 "bilinear")
+            return y
+        return y.astype(jnp.float32)     # keypoints: heatmaps at stride 4
+
+
+@MODELS.register("hrnet_w18_seg")
+def hrnet_w18_seg(num_classes: int = 19, **kw):
+    return HRNet(num_classes=num_classes, base_width=18, head="seg", **kw)
+
+
+@MODELS.register("hrnet_w48_seg")
+def hrnet_w48_seg(num_classes: int = 19, **kw):
+    return HRNet(num_classes=num_classes, base_width=48, head="seg", **kw)
+
+
+@MODELS.register("hrnet_w18_keypoints")
+def hrnet_w18_keypoints(num_classes: int = 17, **kw):
+    """num_classes = number of keypoints (heatmap channels)."""
+    return HRNet(num_classes=num_classes, base_width=18, head="keypoints",
+                 **kw)

@@ -1,0 +1,72 @@
+"""U-Net encoder-decoder for binary/multiclass segmentation.
+
+Surface of Image_segmentation/U-Net (models/networks.py Down/Up blocks,
+bilinear-upsample option, CE+dice training per train.py:107-138).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+
+
+class DoubleConv(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i in range(2):
+            x = nn.Conv(self.features, (3, 3), padding="SAME",
+                        use_bias=False, dtype=self.dtype,
+                        name=f"conv{i}")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name=f"bn{i}")(x)
+            x = nn.relu(x)
+        return x
+
+
+class UNet(nn.Module):
+    num_classes: int = 2
+    base_features: int = 64
+    bilinear: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self.base_features
+        x = x.astype(self.dtype)
+        skips = []
+        widths = [f, f * 2, f * 4, f * 8]
+        for i, w in enumerate(widths):
+            x = DoubleConv(w, self.dtype, name=f"down{i}")(x, train)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        bottleneck_w = f * 16 // (2 if self.bilinear else 1)
+        x = DoubleConv(bottleneck_w, self.dtype, name="bottleneck")(x, train)
+        for i, (w, skip) in enumerate(zip(reversed(widths),
+                                          reversed(skips))):
+            b, h, wd, c = x.shape
+            if self.bilinear:
+                x = jax.image.resize(x, (b, h * 2, wd * 2, c), "bilinear")
+            else:
+                x = nn.ConvTranspose(c // 2, (2, 2), strides=(2, 2),
+                                     dtype=self.dtype,
+                                     name=f"up{i}_tconv")(x)
+            x = jnp.concatenate([skip, x], axis=-1)
+            out_w = w // (2 if self.bilinear and i < 3 else 1)
+            x = DoubleConv(max(out_w, f), self.dtype,
+                           name=f"up{i}")(x, train)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                    name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("unet")
+def unet(num_classes: int = 2, **kw):
+    return UNet(num_classes=num_classes, **kw)

@@ -1,0 +1,1 @@
+from . import mae, supcon  # noqa: F401

@@ -1,0 +1,154 @@
+"""Masked Autoencoder (MAE) pretraining on ViT.
+
+Surface of self-supervised/MAE (models/MAE.py:7: forward :72 with
+shuffle+mask at :85-86, mask_ratio=0.75, lightweight decoder, MSE on
+masked patches :131-141; predict :144 reconstruction; LARS optimizer in
+utils/LARS.py consumed via train/optim.py 'lars').
+
+TPU-first: masking is a single gather by a per-image random permutation
+(argsort of uniform noise — no boolean dynamic shapes); the encoder only
+sees the kept tokens (real 4× FLOP saving at 75% masking), the decoder
+sees kept tokens + learned mask tokens unshuffled back into place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ..classification.vit import Block
+
+
+def random_masking(x: jax.Array, mask_ratio: float, rng: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-image token shuffle-mask. x (B, N, C) → (kept (B, K, C),
+    mask (B, N) 1=masked, restore_idx (B, N))."""
+    b, n, c = x.shape
+    keep = int(n * (1 - mask_ratio))
+    noise = jax.random.uniform(rng, (b, n))
+    shuffle = jnp.argsort(noise, axis=1)          # random perm per image
+    restore = jnp.argsort(shuffle, axis=1)
+    kept_idx = shuffle[:, :keep]
+    kept = jnp.take_along_axis(x, kept_idx[:, :, None], axis=1)
+    mask = jnp.ones((b, n), x.dtype)
+    mask = jnp.take_along_axis(
+        jnp.concatenate([jnp.zeros((b, keep), x.dtype),
+                         jnp.ones((b, n - keep), x.dtype)], axis=1),
+        restore, axis=1)
+    return kept, mask, restore
+
+
+def patchify(imgs: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, C) → (B, N, patch²·C) pixel targets."""
+    b, h, w, c = imgs.shape
+    x = imgs.reshape(b, h // patch, patch, w // patch, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // patch) * (w // patch), patch * patch * c)
+
+
+def unpatchify(x: jax.Array, patch: int, h: int, w: int, c: int = 3
+               ) -> jax.Array:
+    b, n, _ = x.shape
+    x = x.reshape(b, h // patch, w // patch, patch, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h, w, c)
+
+
+class MAE(nn.Module):
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    decoder_dim: int = 512
+    decoder_depth: int = 8
+    decoder_heads: int = 16
+    mask_ratio: float = 0.75
+    norm_pix_loss: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, imgs: jax.Array, train: bool = False,
+                 rng: Optional[jax.Array] = None):
+        """Returns (loss, pred_patches, mask). ``rng`` drives masking; in
+        eval a fixed fold of the dropout rng is used."""
+        if rng is None:
+            rng = self.make_rng("masking")
+        b, h, w, c = imgs.shape
+        p = self.patch_size
+        n = (h // p) * (w // p)
+
+        # ---- encoder over kept tokens only
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p),
+                    dtype=self.dtype, name="patch_embed")(
+            imgs.astype(self.dtype))
+        x = x.reshape(b, n, self.embed_dim)
+        enc_pos = self.param("enc_pos",
+                             nn.initializers.truncated_normal(0.02),
+                             (1, n, self.embed_dim), jnp.float32)
+        x = x + enc_pos.astype(x.dtype)
+        kept, mask, restore = random_masking(x, self.mask_ratio, rng)
+        for i in range(self.depth):
+            kept = Block(self.num_heads, dtype=self.dtype,
+                         name=f"enc_block{i}")(kept, deterministic=not train)
+        kept = nn.LayerNorm(dtype=self.dtype, name="enc_norm")(kept)
+
+        # ---- decoder over full token grid (mask tokens fill the holes)
+        y = nn.Dense(self.decoder_dim, dtype=self.dtype,
+                     name="dec_embed")(kept)
+        mask_token = self.param("mask_token", nn.initializers.normal(0.02),
+                                (1, 1, self.decoder_dim), jnp.float32)
+        k = y.shape[1]
+        fill = jnp.broadcast_to(mask_token.astype(y.dtype),
+                                (b, n - k, self.decoder_dim))
+        full = jnp.concatenate([y, fill], axis=1)
+        full = jnp.take_along_axis(full, restore[:, :, None], axis=1)
+        dec_pos = self.param("dec_pos",
+                             nn.initializers.truncated_normal(0.02),
+                             (1, n, self.decoder_dim), jnp.float32)
+        full = full + dec_pos.astype(full.dtype)
+        for i in range(self.decoder_depth):
+            full = Block(self.decoder_heads, dtype=self.dtype,
+                         name=f"dec_block{i}")(full,
+                                               deterministic=not train)
+        full = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(full)
+        pred = nn.Dense(p * p * c, dtype=self.dtype,
+                        name="dec_pred")(full).astype(jnp.float32)
+
+        # ---- MSE on masked patches only (MAE.py:131-141)
+        target = patchify(imgs, p).astype(jnp.float32)
+        if self.norm_pix_loss:
+            mean = target.mean(axis=-1, keepdims=True)
+            var = target.var(axis=-1, keepdims=True)
+            target = (target - mean) / jnp.sqrt(var + 1e-6)
+        per_patch = jnp.mean(jnp.square(pred - target), axis=-1)
+        maskf = mask.astype(jnp.float32)
+        loss = jnp.sum(per_patch * maskf) / jnp.maximum(jnp.sum(maskf), 1)
+        return loss, pred, mask
+
+    def reconstruct(self, variables, imgs, rng):
+        """predict() surface (MAE.py:144): masked-patch reconstruction
+        composited over the visible original."""
+        loss, pred, mask = self.apply(variables, imgs, train=False, rng=rng)
+        b, h, w, c = imgs.shape
+        p = self.patch_size
+        recon = unpatchify(pred, p, h, w, c)
+        m = mask.reshape(b, h // p, w // p)
+        m = jnp.repeat(jnp.repeat(m, p, axis=1), p, axis=2)[..., None]
+        return imgs * (1 - m) + recon * m
+
+
+@MODELS.register("mae_vit_base_patch16")
+def mae_vit_base_patch16(**kw):
+    return MAE(**kw)
+
+
+@MODELS.register("mae_vit_small_patch16")
+def mae_vit_small_patch16(**kw):
+    defaults = dict(embed_dim=384, depth=6, num_heads=6, decoder_dim=256,
+                    decoder_depth=4, decoder_heads=8)
+    return MAE(**{**defaults, **kw})

@@ -1,0 +1,79 @@
+"""Supervised-contrastive pretraining wrapper + SWA utility.
+
+Surface of self-supervised/SupCon: encoder + 2-layer projection head
+trained with SupConLoss (losses/SupConLoss.py:5 — see
+ops/losses.supcon_loss), then a linear classifier fine-tune
+(trainer/trainer.py:35 contrastive epoch / :100 CE epoch), stochastic
+weight averaging (swa.py), and an LR-range finder (learning_rate_finder.py
+— see train/lr_finder.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from ..classification.resnet import ResNet
+
+
+class SupConModel(nn.Module):
+    """Backbone → normalized projection embedding (+ optional class head
+    for the fine-tune phase)."""
+    backbone: str = "resnet18"
+    proj_dim: int = 128
+    num_classes: int = 0            # >0 enables the classifier head
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, mode: str = "projection"):
+        sizes = {"resnet18": (2, 2, 2, 2), "resnet50": (3, 4, 6, 3)}
+        block = "basic" if self.backbone == "resnet18" else "bottleneck"
+        feats = ResNet(stage_sizes=sizes[self.backbone], block=block,
+                       return_features=True, dtype=self.dtype,
+                       name="encoder")(x, train=train)
+        h = jnp.mean(feats["c5"].astype(jnp.float32), axis=(1, 2))
+        # both heads always run so their params exist regardless of which
+        # mode init was traced in (eval-mode init convention)
+        z = nn.Dense(h.shape[-1], dtype=self.dtype, name="proj1")(
+            h.astype(self.dtype))
+        z = nn.relu(z)
+        z = nn.Dense(self.proj_dim, dtype=self.dtype, name="proj2")(z)
+        z = z.astype(jnp.float32)
+        z = z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-12)
+        logits = None
+        if self.num_classes > 0:
+            logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                              name="classifier")(h.astype(self.dtype)
+                                                 ).astype(jnp.float32)
+        if mode == "features":
+            return h
+        if mode == "classify":
+            if logits is None:
+                raise ValueError("num_classes must be set for classify mode")
+            return logits
+        return z
+
+
+def swa_update(swa_params, params, n_averaged: int):
+    """Running equal-weight average of params (SupCon swa.py surface) —
+    call at each SWA checkpoint; returns (new_swa_params, n+1)."""
+    if swa_params is None:
+        return jax.tree.map(jnp.asarray, params), 1
+    new = jax.tree.map(
+        lambda s, p: s + (p.astype(s.dtype) - s) / (n_averaged + 1),
+        swa_params, params)
+    return new, n_averaged + 1
+
+
+@MODELS.register("supcon_resnet18")
+def supcon_resnet18(num_classes: int = 0, **kw):
+    return SupConModel(backbone="resnet18", num_classes=num_classes, **kw)
+
+
+@MODELS.register("supcon_resnet50")
+def supcon_resnet50(num_classes: int = 0, **kw):
+    return SupConModel(backbone="resnet50", num_classes=num_classes, **kw)

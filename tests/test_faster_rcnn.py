@@ -1,0 +1,129 @@
+"""Faster R-CNN: proposal generation, two-stage losses, postprocess."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.models.detection.faster_rcnn import (
+    fasterrcnn_anchors, fasterrcnn_postprocess, generate_proposals,
+    roi_head_loss, rpn_loss, sample_rois)
+
+IMG = 64
+NC = 4   # incl background
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = MODELS.build("fasterrcnn_resnet18_fpn", num_classes=NC,
+                         dtype=jnp.float32)
+    x = jnp.zeros((1, IMG, IMG, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    anchors = jnp.asarray(fasterrcnn_anchors((IMG, IMG)))
+    return model, variables, anchors
+
+
+class TestFasterRCNN:
+    def test_rpn_outputs_and_anchor_count(self, setup):
+        model, variables, anchors = setup
+        out = model.apply(variables, jnp.zeros((2, IMG, IMG, 3)),
+                          train=False)
+        a = anchors.shape[0]
+        assert out["rpn_obj"].shape == (2, a)
+        assert out["rpn_deltas"].shape == (2, a, 4)
+        assert sum(out["level_counts"]) == a
+
+    def test_proposals_fixed_shape(self, setup):
+        model, variables, anchors = setup
+        out = model.apply(variables, jnp.zeros((2, IMG, IMG, 3)),
+                          train=False)
+        props, valid = generate_proposals(out, anchors, (IMG, IMG),
+                                          pre_nms_top_n=200,
+                                          post_nms_top_n=64)
+        assert props.shape == (2, 64, 4)
+        assert valid.shape == (2, 64)
+        b = np.asarray(props)
+        assert (b >= 0).all() and (b <= IMG).all()
+
+    def test_second_stage_and_losses(self, setup):
+        model, variables, anchors = setup
+        images = jnp.zeros((1, IMG, IMG, 3))
+        out = model.apply(variables, images, train=False)
+        props, pvalid = generate_proposals(out, anchors, (IMG, IMG),
+                                           pre_nms_top_n=200,
+                                           post_nms_top_n=32)
+        gt_boxes = jnp.asarray([[[10.0, 10.0, 40.0, 40.0],
+                                 [0.0, 0.0, 0.0, 0.0]]])
+        gt_labels = jnp.asarray([[2, 0]])
+        gt_valid = jnp.asarray([[True, False]])
+        rl = rpn_loss(out, anchors, gt_boxes, gt_valid, jax.random.key(0))
+        assert np.isfinite(float(rl["rpn_obj_loss"]))
+        assert np.isfinite(float(rl["rpn_reg_loss"]))
+
+        samples = sample_rois(props, pvalid, gt_boxes, gt_labels, gt_valid,
+                              jax.random.key(1), batch_per_image=32)
+        assert samples["rois"].shape == (1, 32 + 2, 4)
+        out2 = model.apply(variables, images, proposals=samples["rois"],
+                           train=False)
+        assert out2["roi_scores"].shape == (1, 34, NC)
+        assert out2["roi_deltas"].shape == (1, 34, NC, 4)
+        hl = roi_head_loss(out2["roi_scores"], out2["roi_deltas"], samples)
+        assert np.isfinite(float(hl["roi_cls_loss"]))
+        assert np.isfinite(float(hl["roi_reg_loss"]))
+        # gt box was appended to rois -> at least one positive sample
+        assert int(samples["pos"].sum()) >= 1
+
+    def test_postprocess_fixed_shapes(self, setup):
+        model, variables, anchors = setup
+        images = jnp.zeros((2, IMG, IMG, 3))
+        out = model.apply(variables, images, train=False)
+        props, pvalid = generate_proposals(out, anchors, (IMG, IMG),
+                                           pre_nms_top_n=200,
+                                           post_nms_top_n=32)
+        out2 = model.apply(variables, images, proposals=props, train=False)
+        det = fasterrcnn_postprocess(out2["roi_scores"],
+                                     out2["roi_deltas"], props,
+                                     (IMG, IMG), max_det=20,
+                                     score_thresh=0.0)
+        assert det["boxes"].shape == (2, 20, 4)
+        assert det["labels"].shape == (2, 20)
+        lab = np.asarray(det["labels"])[np.asarray(det["valid"])]
+        assert (lab >= 1).all()          # background never emitted
+
+    def test_end_to_end_jit(self, setup):
+        """The whole two-stage train-mode computation jits as one graph."""
+        model, variables, anchors = setup
+        gt_boxes = jnp.asarray([[[10.0, 10.0, 40.0, 40.0]]])
+        gt_labels = jnp.asarray([[1]])
+        gt_valid = jnp.asarray([[True]])
+
+        @jax.jit
+        def full_loss(params, images, rng):
+            out = model.apply({"params": params,
+                               "batch_stats": variables["batch_stats"]},
+                              images, train=False)
+            props, pvalid = generate_proposals(out, anchors, (IMG, IMG),
+                                               pre_nms_top_n=100,
+                                               post_nms_top_n=16)
+            r = rpn_loss(out, anchors, gt_boxes, gt_valid, rng)
+            samples = sample_rois(props, pvalid, gt_boxes, gt_labels,
+                                  gt_valid, rng, batch_per_image=16)
+            out2 = model.apply({"params": params,
+                                "batch_stats": variables["batch_stats"]},
+                               images, proposals=samples["rois"],
+                               train=False)
+            h = roi_head_loss(out2["roi_scores"], out2["roi_deltas"],
+                              samples)
+            return (r["rpn_obj_loss"] + r["rpn_reg_loss"]
+                    + h["roi_cls_loss"] + h["roi_reg_loss"])
+
+        loss = full_loss(variables["params"], jnp.zeros((1, IMG, IMG, 3)),
+                         jax.random.key(0))
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda p: full_loss(p, jnp.zeros((1, IMG, IMG, 3)),
+                                         jax.random.key(0)))(
+            variables["params"])
+        gn = np.sqrt(sum(float(jnp.sum(x ** 2))
+                         for x in jax.tree.leaves(g)))
+        assert np.isfinite(gn) and gn > 0

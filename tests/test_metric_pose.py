@@ -1,0 +1,130 @@
+"""Metric learning (BDB/ArcFace/CMC/re-ranking) + pose (heatmaps/OKS)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.evaluation.keypoints import (decode_heatmaps,
+                                                   make_heatmap_targets,
+                                                   oks, oks_ap, pck)
+from deeplearning_tpu.evaluation.retrieval import (cmc_map,
+                                                   k_reciprocal_rerank,
+                                                   pairwise_distances)
+from deeplearning_tpu.ops import losses as L
+
+
+class TestBDB:
+    def test_outputs_and_batch_drop(self):
+        model = MODELS.build("bdb_resnet50", num_classes=10,
+                             dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out["embedding"].shape == (2, 512 + 1024)
+        assert out["global_logits"].shape == (2, 10)
+        # train mode requires dropout rng (batch drop) and changes part path
+        out_t = model.apply(variables, x, train=True,
+                            rngs={"dropout": jax.random.key(1)},
+                            mutable=["batch_stats"])[0]
+        assert not np.allclose(np.asarray(out_t["part_embedding"]),
+                               np.asarray(out["part_embedding"]))
+
+    def test_batch_drop_block_masks_block(self):
+        from deeplearning_tpu.models.metric.bdb import batch_drop_block
+        x = jnp.ones((2, 12, 8, 3))
+        y = batch_drop_block(x, jax.random.key(0), 0.25, 1.0)
+        dropped = np.asarray(y == 0).all(axis=(0, 3))    # same across batch
+        assert dropped.sum() == 3 * 8                     # rh=3, full width
+
+    def test_triplet_and_arcface_losses(self):
+        emb = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)),
+                          jnp.float32)
+        labels = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3])
+        tl = L.triplet_loss(emb, labels, margin=0.3)
+        assert np.isfinite(float(tl))
+        model = MODELS.build("arcface_resnet18", num_classes=5,
+                             dtype=jnp.float32)
+        x = jnp.zeros((4, 32, 32, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        logits = L.arcface_logits(out["embedding"], out["centers"],
+                                  jnp.asarray([0, 1, 2, 3]))
+        assert logits.shape == (4, 5)
+        ce = L.cross_entropy(logits, jnp.asarray([0, 1, 2, 3]))
+        assert np.isfinite(float(ce))
+
+
+class TestRetrievalMetrics:
+    def _toy(self):
+        # gallery has 2 entries per id; queries are noisy copies
+        rng = np.random.default_rng(0)
+        centers = rng.normal(0, 5, (4, 8))
+        g_feats = np.concatenate([centers + rng.normal(0, 0.1, (4, 8)),
+                                  centers + rng.normal(0, 0.1, (4, 8))])
+        g_ids = np.concatenate([np.arange(4), np.arange(4)])
+        q_feats = centers + rng.normal(0, 0.1, (4, 8))
+        q_ids = np.arange(4)
+        return q_feats, q_ids, g_feats, g_ids
+
+    def test_cmc_map_perfect(self):
+        q_feats, q_ids, g_feats, g_ids = self._toy()
+        dist = pairwise_distances(q_feats, g_feats)
+        res = cmc_map(dist, q_ids, g_ids)
+        assert res["rank1"] == 1.0
+        assert res["mAP"] == pytest.approx(1.0)
+
+    def test_camera_filtering(self):
+        q_feats, q_ids, g_feats, g_ids = self._toy()
+        # first gallery copy shares the camera with queries -> removed
+        g_cams = np.concatenate([np.zeros(4), np.ones(4)]).astype(int)
+        q_cams = np.zeros(4, int)
+        dist = pairwise_distances(q_feats, g_feats)
+        res = cmc_map(dist, q_ids, g_ids, q_cams, g_cams)
+        assert res["rank1"] == 1.0      # second copy still matches
+
+    def test_rerank_improves_or_keeps_ranking(self):
+        q_feats, q_ids, g_feats, g_ids = self._toy()
+        re_dist = k_reciprocal_rerank(q_feats, g_feats, k1=4, k2=2)
+        assert re_dist.shape == (4, 8)
+        res = cmc_map(re_dist, q_ids, g_ids)
+        assert res["rank1"] == 1.0
+
+
+class TestPose:
+    def test_heatmap_roundtrip(self):
+        kps = np.asarray([[12.0, 20.0], [40.0, 8.0]])
+        vis = np.asarray([2, 1])
+        heat = make_heatmap_targets(kps, vis, (16, 16), stride=4)
+        assert heat.shape == (16, 16, 2)
+        decoded, scores = decode_heatmaps(jnp.asarray(heat[None]), stride=4)
+        np.testing.assert_allclose(np.asarray(decoded[0]), kps, atol=2.0)
+        assert float(scores[0, 0]) == pytest.approx(1.0, abs=1e-5)
+
+    def test_heatmap_loss_visibility(self):
+        pred = jnp.zeros((1, 8, 8, 2))
+        target = jnp.ones((1, 8, 8, 2))
+        vis = jnp.asarray([[1, 0]])
+        loss = L.heatmap_mse_loss(pred, target, vis)
+        assert float(loss) == pytest.approx(1.0)   # only visible kp counts
+
+    def test_oks_and_pck(self):
+        gt = np.asarray([[10.0, 10], [20, 20], [30, 30]])
+        vis = np.asarray([2, 2, 0])
+        assert oks(gt, gt, vis, area=100.0) == pytest.approx(1.0)
+        noisy = gt + 50.0
+        assert oks(noisy, gt, vis, area=100.0) < 0.1
+        assert pck(gt + 1.0, gt, vis, threshold_px=2.0) == 1.0
+        assert pck(gt + 5.0, gt, vis, threshold_px=2.0) == 0.0
+
+    def test_oks_ap_summary(self):
+        gts = [{"keypoints": np.asarray([[10.0, 10], [20, 20]]),
+                "visible": np.asarray([2, 2]), "area": 100.0}
+               for _ in range(4)]
+        preds = [{"keypoints": g["keypoints"] + (0.1 if i < 3 else 50),
+                  "score": 1.0 - 0.1 * i}
+                 for i, g in enumerate(gts)]
+        res = oks_ap(preds, gts)
+        assert 0.5 < res["AP50"] < 0.8            # 3 of 4 found (~0.752)

@@ -1,0 +1,172 @@
+"""Segmentation + SSL model tests: shapes, losses, learning checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
+                                                 miou_from_confusion)
+from deeplearning_tpu.ops import losses as L
+
+
+class TestSegmentationModels:
+    @pytest.mark.parametrize("name", ["unet", "fcn_resnet50",
+                                      "deeplabv3_resnet50",
+                                      "deeplabv3plus_resnet50",
+                                      "hrnet_w18_seg"])
+    def test_forward_shape(self, name):
+        model = MODELS.build(name, num_classes=5, dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (1, 64, 64, 5)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_fcn_aux_tuple_in_train(self):
+        model = MODELS.build("fcn_resnet50", num_classes=3,
+                             dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=True,
+                          rngs={"dropout": jax.random.key(1)},
+                          mutable=["batch_stats"])[0]
+        logits, aux = out
+        assert logits.shape == aux.shape == (1, 64, 64, 3)
+
+    def test_unet_overfits_binary_mask(self):
+        model = MODELS.build("unet", num_classes=2, base_features=8,
+                             dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(0, 0.1, (2, 32, 32, 3)), jnp.float32)
+        y = np.zeros((2, 32, 32), np.int32)
+        y[:, 8:24, 8:24] = 1
+        x = x.at[:, 8:24, 8:24, :].add(1.5)
+        y = jnp.asarray(y)
+        variables = model.init(jax.random.key(0), x, train=False)
+        params, stats = variables["params"], variables["batch_stats"]
+        tx = optax.adam(3e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, stats):
+            def loss_fn(p):
+                logits, mut = model.apply(
+                    {"params": p, "batch_stats": stats}, x, train=True,
+                    mutable=["batch_stats"])
+                loss = L.cross_entropy(logits, y) + L.dice_loss(logits, y)
+                return loss, mut["batch_stats"]
+            (loss, stats2), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params)
+            up, opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, up), opt, stats2, loss
+
+        first = None
+        for _ in range(30):
+            params, opt, stats, loss = step(params, opt, stats)
+            first = first or float(loss)
+        assert float(loss) < first * 0.3
+        # mIoU on the training image should be high
+        logits = model.apply({"params": params, "batch_stats": stats}, x,
+                             train=False)
+        pred = jnp.argmax(logits, -1)
+        cm = confusion_matrix(pred, y, 2)
+        m = miou_from_confusion(np.asarray(cm))
+        assert m["miou"] > 0.8
+
+    def test_hrnet_keypoint_head_stride4(self):
+        model = MODELS.build("hrnet_w18_keypoints", num_classes=7,
+                             dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (1, 16, 16, 7)
+
+
+class TestMAE:
+    def test_loss_and_shapes(self):
+        model = MODELS.build("mae_vit_small_patch16", dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                        jnp.float32)
+        variables = model.init(
+            {"params": jax.random.key(0), "masking": jax.random.key(1)},
+            x, train=False)
+        loss, pred, mask = model.apply(
+            variables, x, train=False, rngs={"masking": jax.random.key(2)})
+        n = (64 // 16) ** 2
+        assert pred.shape == (2, n, 16 * 16 * 3)
+        assert mask.shape == (2, n)
+        # exactly 75% masked
+        assert int(mask.sum()) == int(2 * n * 0.75)
+        assert np.isfinite(float(loss))
+
+    def test_mask_ratio_token_saving(self):
+        # encoder must only process kept tokens: check intermediate shape
+        from deeplearning_tpu.models.ssl.mae import random_masking
+        x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+        kept, mask, restore = random_masking(x, 0.75, jax.random.key(0))
+        assert kept.shape == (2, 4, 4)
+        # restore permutation is the inverse of the shuffle: gathering the
+        # kept+masked concat by restore puts kept rows where mask==0
+        assert np.all(np.asarray(mask.sum(1)) == 12)
+
+    def test_loss_decreases(self):
+        model = MODELS.build("mae_vit_small_patch16", dtype=jnp.float32,
+                             decoder_depth=2, depth=2)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)),
+                        jnp.float32)
+        variables = model.init(
+            {"params": jax.random.key(0), "masking": jax.random.key(1)},
+            x, train=False)
+        params = variables["params"]
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, i):
+            def loss_fn(p):
+                loss, _, _ = model.apply(
+                    {"params": p}, x, train=True,
+                    rngs={"masking": jax.random.key(5),
+                          "dropout": jax.random.fold_in(jax.random.key(6), i)})
+                return loss
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            up, opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, up), opt, loss
+
+        first = None
+        for i in range(20):
+            params, opt, loss = step(params, opt, i)
+            first = first or float(loss)
+        assert float(loss) < first * 0.8
+
+
+class TestSupCon:
+    def test_projection_normalized_and_loss(self):
+        model = MODELS.build("supcon_resnet18", num_classes=4,
+                             dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 32, 32, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        z = model.apply(variables, x, train=False)
+        norms = np.linalg.norm(np.asarray(z), axis=-1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+        # two views: (B, V, D)
+        feats = jnp.stack([z, z], axis=1)
+        labels = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3])
+        loss = L.supcon_loss(feats, labels)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        # classify mode
+        logits = model.apply(variables, x, train=False, mode="classify")
+        assert logits.shape == (8, 4)
+
+    def test_swa_average(self):
+        from deeplearning_tpu.models.ssl.supcon import swa_update
+        p1 = {"w": jnp.ones(3)}
+        p2 = {"w": jnp.ones(3) * 3}
+        swa, n = swa_update(None, p1, 0)
+        swa, n = swa_update(swa, p2, n)
+        np.testing.assert_allclose(np.asarray(swa["w"]), 2.0)
+        assert n == 2
