@@ -1,1 +1,2 @@
+from . import label_convert, mixup, transforms  # noqa: F401
 from .loader import ArraySource, MapSource, DataLoader, prefetch_to_device  # noqa: F401

@@ -1,0 +1,133 @@
+"""Mixup / CutMix batch augmentation + mosaic for detection.
+
+Surface of the timm-style mixup the B-harness uses (swin main.py:111-118
+mixup_fn with label smoothing folded into soft targets) and YOLOX's
+MosaicDetection (yolox/data/datasets/mosaicdetection.py:37: 4-image
+mosaic + box-aware mixup). Mixup/cutmix are jittable (device-side, on the
+global batch); mosaic is host numpy (it reshapes images before batching).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def one_hot_smooth(labels: jax.Array, num_classes: int,
+                   smoothing: float = 0.0) -> jax.Array:
+    off = smoothing / num_classes
+    on = 1.0 - smoothing + off
+    return jax.nn.one_hot(labels, num_classes) * (on - off) + off
+
+
+def mixup_cutmix(batch: Dict[str, jax.Array], rng: jax.Array,
+                 num_classes: int, mixup_alpha: float = 0.8,
+                 cutmix_alpha: float = 1.0, smoothing: float = 0.1,
+                 switch_prob: float = 0.5) -> Dict[str, jax.Array]:
+    """Pair each sample with the reversed batch; mixup or cutmix chosen
+    per batch. Returns batch with soft-target 'label'."""
+    imgs = batch["image"]
+    labels = batch["label"]
+    k_lam, k_switch, k_box = jax.random.split(rng, 3)
+    use_cutmix = jax.random.uniform(k_switch) < switch_prob
+    alpha = jnp.where(use_cutmix, cutmix_alpha, mixup_alpha)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+
+    flipped = imgs[::-1]
+    b, h, w, c = imgs.shape
+    # cutmix box with area ratio (1-lam)
+    cut = jnp.sqrt(1.0 - lam)
+    ch, cw = (h * cut).astype(jnp.int32), (w * cut).astype(jnp.int32)
+    ky, kx = jax.random.split(k_box)
+    cy = jax.random.randint(ky, (), 0, h)
+    cx = jax.random.randint(kx, (), 0, w)
+    y0 = jnp.clip(cy - ch // 2, 0, h)
+    x0 = jnp.clip(cx - cw // 2, 0, w)
+    y1 = jnp.clip(cy + ch // 2, 0, h)
+    x1 = jnp.clip(cx + cw // 2, 0, w)
+    rows = jnp.arange(h)[None, :, None, None]
+    cols = jnp.arange(w)[None, None, :, None]
+    in_box = ((rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1))
+    lam_cutmix = 1.0 - ((y1 - y0) * (x1 - x0)) / (h * w)
+
+    mixed_mixup = lam * imgs + (1 - lam) * flipped
+    mixed_cutmix = jnp.where(in_box, flipped, imgs)
+    out_imgs = jnp.where(use_cutmix, mixed_cutmix, mixed_mixup)
+    lam_eff = jnp.where(use_cutmix, lam_cutmix, lam)
+
+    t1 = one_hot_smooth(labels, num_classes, smoothing)
+    t2 = one_hot_smooth(labels[::-1], num_classes, smoothing)
+    soft = lam_eff * t1 + (1 - lam_eff) * t2
+    return {**batch, "image": out_imgs.astype(imgs.dtype), "label": soft}
+
+
+def mosaic4(images: Sequence[np.ndarray], boxes: Sequence[np.ndarray],
+            labels: Sequence[np.ndarray], out_size: int,
+            rng: np.random.Generator,
+            max_boxes: int = 64) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+    """4-image mosaic (MosaicDetection surface): random center, each
+    quadrant filled by one scaled image; boxes shifted+clipped, padded to
+    ``max_boxes`` with a validity mask. Host-side numpy."""
+    assert len(images) == 4
+    s = out_size
+    yc = int(rng.uniform(0.5 * s, 1.5 * s))
+    xc = int(rng.uniform(0.5 * s, 1.5 * s))
+    canvas = np.full((2 * s, 2 * s, images[0].shape[-1]), 114.0, np.float32)
+    all_boxes, all_labels = [], []
+    from .transforms import resize_bilinear
+    for i, (img, bxs, lbs) in enumerate(zip(images, boxes, labels)):
+        h0, w0 = img.shape[:2]
+        scale = min(s / h0, s / w0) * rng.uniform(0.5, 1.5)
+        nh, nw = max(int(h0 * scale), 1), max(int(w0 * scale), 1)
+        img = resize_bilinear(img, (nh, nw))
+        if i == 0:      # top-left quadrant, anchored at (yc, xc)
+            y1a, x1a = max(yc - nh, 0), max(xc - nw, 0)
+            y2a, x2a = yc, xc
+        elif i == 1:    # top-right
+            y1a, x1a = max(yc - nh, 0), xc
+            y2a, x2a = yc, min(xc + nw, 2 * s)
+        elif i == 2:    # bottom-left
+            y1a, x1a = yc, max(xc - nw, 0)
+            y2a, x2a = min(yc + nh, 2 * s), xc
+        else:           # bottom-right
+            y1a, x1a = yc, xc
+            y2a, x2a = min(yc + nh, 2 * s), min(xc + nw, 2 * s)
+        # matching source crop
+        y1b = nh - (y2a - y1a) if i < 2 else 0
+        x1b = nw - (x2a - x1a) if i in (0, 2) else 0
+        canvas[y1a:y2a, x1a:x2a] = img[y1b:y1b + (y2a - y1a),
+                                       x1b:x1b + (x2a - x1a)]
+        if len(bxs):
+            shifted = np.asarray(bxs, np.float32) * scale
+            shifted[:, [0, 2]] += x1a - x1b
+            shifted[:, [1, 3]] += y1a - y1b
+            all_boxes.append(shifted)
+            all_labels.append(np.asarray(lbs))
+    if all_boxes:
+        out_boxes = np.concatenate(all_boxes)
+        out_labels = np.concatenate(all_labels)
+        out_boxes[:, [0, 2]] = out_boxes[:, [0, 2]].clip(0, 2 * s)
+        out_boxes[:, [1, 3]] = out_boxes[:, [1, 3]].clip(0, 2 * s)
+        wh = out_boxes[:, 2:] - out_boxes[:, :2]
+        keep = (wh > 2).all(axis=1)
+        out_boxes, out_labels = out_boxes[keep], out_labels[keep]
+    else:
+        out_boxes = np.zeros((0, 4), np.float32)
+        out_labels = np.zeros((0,), np.int64)
+    # downscale canvas 2s -> s
+    canvas = resize_bilinear(canvas, (s, s))
+    out_boxes = out_boxes / 2.0
+    # pad to fixed count
+    n = len(out_boxes)
+    boxes_pad = np.zeros((max_boxes, 4), np.float32)
+    labels_pad = np.zeros((max_boxes,), np.int64)
+    valid = np.zeros((max_boxes,), bool)
+    take = min(n, max_boxes)
+    boxes_pad[:take] = out_boxes[:take]
+    labels_pad[:take] = out_labels[:take]
+    valid[:take] = True
+    return canvas, boxes_pad, labels_pad, valid

@@ -1,0 +1,230 @@
+"""FCOS: anchor-free per-pixel detection with center-ness.
+
+Surface of detection/FCOS: FCOS/FCOSDetector (models/fcos.py:15/:85),
+shared 4-conv heads with a learnable per-level scale on the exp regression
+(fcos.py ScaleExp), GenTargets (models/loss.py:27 — per-level location
+targets :66 by in-box test + scale-range assignment, center sampling),
+Loss (:216 — focal :344, centerness BCE :279, GIoU :311), DetectHead
+(:141 postprocess).
+
+TPU-first: locations per level are static grids; target generation is a
+dense (locations × MAX_GT) masked min/argmin — no per-image loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ...ops import boxes as box_ops
+from ...ops import losses as L
+from ...ops import nms as nms_ops
+from ..classification.resnet import ResNet
+from .fpn import FPN
+
+# per-level regression ranges (loss.py limit_range)
+LEVEL_RANGES = ((-1, 64), (64, 128), (128, 256), (256, 512), (512, 1e8))
+STRIDES = (8, 16, 32, 64, 128)
+
+
+class ScaleExp(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        s = self.param("scale", nn.initializers.ones, ())
+        return jnp.exp(x * s)
+
+
+class FCOSHead(nn.Module):
+    num_classes: int
+    num_convs: int = 4
+    channels: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: Dict[str, jax.Array]):
+        cls_tower = [nn.Conv(self.channels, (3, 3), padding="SAME",
+                             dtype=self.dtype, name=f"cls_conv{i}")
+                     for i in range(self.num_convs)]
+        reg_tower = [nn.Conv(self.channels, (3, 3), padding="SAME",
+                             dtype=self.dtype, name=f"reg_conv{i}")
+                     for i in range(self.num_convs)]
+        cls_pred = nn.Conv(self.num_classes, (3, 3), padding="SAME",
+                           bias_init=nn.initializers.constant(
+                               -math.log((1 - 0.01) / 0.01)),
+                           dtype=self.dtype, name="cls_pred")
+        ctr_pred = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
+                           name="ctr_pred")
+        reg_pred = nn.Conv(4, (3, 3), padding="SAME", dtype=self.dtype,
+                           name="reg_pred")
+        cls_out, ctr_out, reg_out = [], [], []
+        for li, name in enumerate(sorted(feats, key=lambda k: int(k[1:]))):
+            x = feats[name]
+            c = x
+            for conv in cls_tower:
+                c = nn.relu(conv(c))
+            r = x
+            for conv in reg_tower:
+                r = nn.relu(conv(r))
+            b = x.shape[0]
+            cls_out.append(cls_pred(c).reshape(
+                b, -1, self.num_classes).astype(jnp.float32))
+            ctr_out.append(ctr_pred(r).reshape(b, -1).astype(jnp.float32))
+            ltrb = ScaleExp(name=f"scale{li}")(
+                reg_pred(r).astype(jnp.float32))
+            reg_out.append(ltrb.reshape(b, -1, 4))
+        return (jnp.concatenate(cls_out, 1), jnp.concatenate(ctr_out, 1),
+                jnp.concatenate(reg_out, 1))
+
+
+class FCOS(nn.Module):
+    num_classes: int = 20
+    backbone_sizes: Sequence[int] = (3, 4, 6, 3)
+    fpn_channels: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        feats = ResNet(stage_sizes=self.backbone_sizes,
+                       return_features=True, dtype=self.dtype,
+                       name="backbone")(images, train=train)
+        feats = {k: v for k, v in feats.items() if k in ("c3", "c4", "c5")}
+        pyramid = FPN(self.fpn_channels, extra_levels="p6p7",
+                      dtype=self.dtype, name="fpn")(feats)
+        cls_logits, centerness, ltrb = FCOSHead(
+            self.num_classes, dtype=self.dtype, name="head")(pyramid)
+        return {"cls_logits": cls_logits, "centerness": centerness,
+                "ltrb": ltrb}
+
+
+def fcos_locations(image_hw: Tuple[int, int]) -> Tuple[np.ndarray, np.ndarray]:
+    """All-level (x, y) centers + per-location level index."""
+    h, w = image_hw
+    locs, lvl = [], []
+    for li, s in enumerate(STRIDES):
+        fh, fw = math.ceil(h / s), math.ceil(w / s)
+        ys, xs = np.mgrid[0:fh, 0:fw].astype(np.float32)
+        pts = np.stack([(xs + 0.5) * s, (ys + 0.5) * s],
+                       axis=-1).reshape(-1, 2)
+        locs.append(pts)
+        lvl.append(np.full(len(pts), li))
+    return np.concatenate(locs), np.concatenate(lvl)
+
+
+def fcos_targets(locations: jax.Array, level_idx: jax.Array,
+                 gt_boxes: jax.Array, gt_labels: jax.Array,
+                 gt_valid: jax.Array, center_radius: float = 1.5
+                 ) -> Dict[str, jax.Array]:
+    """Per-location targets (GenTargets surface): a location is positive
+    if inside a gt (center-sampled) and its max ltrb falls in its level's
+    range; ambiguity resolved by min-area gt."""
+    ranges = jnp.asarray(LEVEL_RANGES)[level_idx]        # (L, 2)
+    strides = jnp.asarray(STRIDES, jnp.float32)[level_idx]
+
+    def per_image(boxes, labels, valid):
+        x = locations[:, 0][:, None]                     # (L, 1)
+        y = locations[:, 1][:, None]
+        l = x - boxes[None, :, 0]                        # (L, G)
+        t = y - boxes[None, :, 1]
+        r = boxes[None, :, 2] - x
+        b = boxes[None, :, 3] - y
+        ltrb = jnp.stack([l, t, r, b], axis=-1)
+        in_box = jnp.min(ltrb, -1) > 0
+        max_reg = jnp.max(ltrb, -1)
+        in_level = (max_reg >= ranges[:, 0:1]) & (max_reg <= ranges[:, 1:2])
+        # center sampling: within radius*stride of gt center
+        cx = (boxes[None, :, 0] + boxes[None, :, 2]) / 2
+        cy = (boxes[None, :, 1] + boxes[None, :, 3]) / 2
+        near = (jnp.abs(x - cx) <= center_radius * strides[:, None]) & \
+            (jnp.abs(y - cy) <= center_radius * strides[:, None])
+        cand = in_box & in_level & near & valid[None, :]
+        area = box_ops.box_area(boxes)
+        area_mat = jnp.where(cand, area[None, :], jnp.inf)
+        best_gt = jnp.argmin(area_mat, axis=1)           # (L,)
+        pos = jnp.any(cand, axis=1)
+        cls_target = jnp.where(pos, labels[best_gt], -1)  # -1 = background
+        reg_target = jnp.take_along_axis(
+            ltrb, best_gt[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        lr = reg_target[:, [0, 2]]
+        tb = reg_target[:, [1, 3]]
+        ctr_target = jnp.sqrt(jnp.clip(
+            (jnp.min(lr, -1) / jnp.maximum(jnp.max(lr, -1), 1e-9)) *
+            (jnp.min(tb, -1) / jnp.maximum(jnp.max(tb, -1), 1e-9)), 0, 1))
+        return {"cls": cls_target, "reg": reg_target, "ctr": ctr_target,
+                "pos": pos}
+
+    return jax.vmap(per_image)(gt_boxes, gt_labels, gt_valid)
+
+
+def fcos_loss(outputs: Dict, targets: Dict) -> Dict[str, jax.Array]:
+    num_classes = outputs["cls_logits"].shape[-1]
+
+    def per_image(cls_logits, ctr, ltrb, tgt_cls, tgt_reg, tgt_ctr, pos):
+        onehot = jax.nn.one_hot(jnp.where(tgt_cls >= 0, tgt_cls, 0),
+                                num_classes) * (tgt_cls >= 0)[:, None]
+        num_pos = jnp.maximum(jnp.sum(pos), 1)
+        cls_loss = L.sigmoid_focal_loss(cls_logits, onehot,
+                                        reduction="sum") / num_pos
+        ctr_loss = L.binary_cross_entropy(ctr, tgt_ctr, weights=pos) \
+            * jnp.sum(pos) / num_pos
+        # GIoU on decoded boxes, centerness-weighted (FCOS-style)
+        pred_boxes = jnp.stack([-ltrb[:, 0], -ltrb[:, 1],
+                                ltrb[:, 2], ltrb[:, 3]], -1)
+        tgt_boxes = jnp.stack([-tgt_reg[:, 0], -tgt_reg[:, 1],
+                               tgt_reg[:, 2], tgt_reg[:, 3]], -1)
+        giou = box_ops.elementwise_box_iou(pred_boxes, tgt_boxes, "giou")
+        w = pos * tgt_ctr
+        reg_loss = jnp.sum((1 - giou) * w) / jnp.maximum(jnp.sum(w), 1e-6)
+        return cls_loss, ctr_loss, reg_loss
+
+    cls_l, ctr_l, reg_l = jax.vmap(per_image)(
+        outputs["cls_logits"], outputs["centerness"], outputs["ltrb"],
+        targets["cls"], targets["reg"], targets["ctr"], targets["pos"])
+    return {"cls_loss": jnp.mean(cls_l), "ctr_loss": jnp.mean(ctr_l),
+            "reg_loss": jnp.mean(reg_l)}
+
+
+def fcos_postprocess(outputs: Dict, locations: jax.Array,
+                     image_hw: Tuple[int, int], score_thresh: float = 0.05,
+                     nms_thresh: float = 0.6, topk: int = 1000,
+                     max_det: int = 100) -> Dict[str, jax.Array]:
+    def per_image(cls_logits, ctr, ltrb):
+        scores = jnp.sqrt(jax.nn.sigmoid(cls_logits)
+                          * jax.nn.sigmoid(ctr)[:, None])
+        boxes = jnp.stack([
+            locations[:, 0] - ltrb[:, 0], locations[:, 1] - ltrb[:, 1],
+            locations[:, 0] + ltrb[:, 2], locations[:, 1] + ltrb[:, 3]],
+            axis=-1)
+        boxes = box_ops.clip_boxes(boxes, image_hw)
+        flat = scores.reshape(-1)
+        k = min(topk, flat.shape[0])
+        top_s, top_i = jax.lax.top_k(flat, k)
+        nc = cls_logits.shape[-1]
+        loc_i = top_i // nc
+        cls_i = top_i % nc
+        keep_idx, keep_valid = nms_ops.batched_nms(
+            boxes[loc_i], top_s, cls_i, nms_thresh, max_det,
+            score_threshold=score_thresh)
+        bsel, ssel, csel = nms_ops.gather_nms_outputs(
+            keep_idx, keep_valid, boxes[loc_i], top_s, cls_i)
+        return bsel, ssel, csel, keep_valid
+
+    boxes, scores, classes, valid = jax.vmap(per_image)(
+        outputs["cls_logits"], outputs["centerness"], outputs["ltrb"])
+    return {"boxes": boxes, "scores": scores, "labels": classes,
+            "valid": valid}
+
+
+@MODELS.register("fcos_resnet50_fpn")
+def fcos_resnet50_fpn(num_classes: int = 20, **kw):
+    return FCOS(num_classes=num_classes, **kw)
+
+
+@MODELS.register("fcos_resnet18_fpn")
+def fcos_resnet18_fpn(num_classes: int = 20, **kw):
+    return FCOS(num_classes=num_classes, backbone_sizes=(2, 2, 2, 2), **kw)

@@ -1,0 +1,60 @@
+"""LR range test (SupCon learning_rate_finder.py surface): sweep lr
+exponentially over one pass, record smoothed loss, suggest the steepest-
+descent lr."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import numpy as np
+import optax
+
+
+def lr_range_test(
+    make_state: Callable[[optax.Schedule], object],
+    train_step_factory: Callable[[object], Callable],
+    batches,
+    min_lr: float = 1e-7,
+    max_lr: float = 1.0,
+    beta: float = 0.98,
+) -> Dict[str, np.ndarray]:
+    """make_state(schedule) builds a fresh TrainState with the given lr
+    schedule; train_step_factory(state) returns the jitted step. Returns
+    {lrs, losses, suggestion}."""
+    batches = list(batches)
+    n = len(batches)
+    lrs = np.exp(np.linspace(np.log(min_lr), np.log(max_lr), n))
+
+    def schedule(step):
+        import jax.numpy as jnp
+        idx = jnp.clip(step, 0, n - 1)
+        return jnp.asarray(lrs)[idx]
+
+    state = make_state(schedule)
+    step_fn = train_step_factory(state)
+    rng = jax.random.key(0)
+    avg = 0.0
+    smoothed: List[float] = []
+    best = np.inf
+    for i, batch in enumerate(batches):
+        state, metrics = step_fn(state, batch, rng)
+        loss = float(metrics["loss"])
+        avg = beta * avg + (1 - beta) * loss
+        corrected = avg / (1 - beta ** (i + 1))
+        smoothed.append(corrected)
+        best = min(best, corrected)
+        if corrected > 4 * best and i > n // 10:   # diverged: stop early
+            lrs = lrs[: i + 1]
+            break
+    losses = np.asarray(smoothed)
+    # steepest negative slope of smoothed loss; skip the warmup-biased
+    # first 10% of points (standard LR-finder practice)
+    if len(losses) > 2:
+        slopes = np.gradient(losses, np.log(lrs[: len(losses)]))
+        skip = max(len(slopes) // 10, 1)
+        suggestion = float(lrs[skip + int(np.argmin(slopes[skip:]))])
+    else:
+        suggestion = float(lrs[0])
+    return {"lrs": lrs[: len(losses)], "losses": losses,
+            "suggestion": suggestion}

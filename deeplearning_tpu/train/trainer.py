@@ -1,0 +1,183 @@
+"""Hook-structured Trainer — the ONE shared harness (SURVEY.md §1.1 goal).
+
+Merges the three reference archetypes: the simple epoch loop
+(classification/mnist/train.py:141), the yacs/DDP/AMP harness features
+(swin main.py:84-300: accumulation, auto-resume, save-freq, throughput
+mode), and YOLOX's hook skeleton (yolox/core/trainer.py:69-88:
+before_train/before_epoch/before_iter/after_iter/after_epoch/after_train)
+with yolov5's Callbacks event registry (utils/callbacks.py:8).
+
+The Trainer owns: the jitted steps, the loader epoch protocol
+(set_epoch), metric meters, TB writer, Orbax checkpointing with best
+tracking, EMA-evaluation, and hook dispatch. Everything device-side stays
+in the jitted step functions it is given.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core import rng as rng_mod
+from ..core.checkpoint import CheckpointManager
+from ..core.logging import (MetricLogger, TensorBoardWriter, create_logger,
+                            is_main_process)
+
+HOOKS = ("before_train", "after_train", "before_epoch", "after_epoch",
+         "before_iter", "after_iter", "on_evaluate", "on_checkpoint")
+
+
+class Callbacks:
+    """Named hook registry (yolov5 utils/callbacks.py surface)."""
+
+    def __init__(self):
+        self._hooks: Dict[str, List[Callable]] = defaultdict(list)
+
+    def register(self, event: str, fn: Callable) -> None:
+        if event not in HOOKS:
+            raise KeyError(f"Unknown hook {event!r}; valid: {HOOKS}")
+        self._hooks[event].append(fn)
+
+    def fire(self, event: str, trainer: "Trainer", **kw) -> None:
+        for fn in self._hooks[event]:
+            fn(trainer, **kw)
+
+
+class Trainer:
+    def __init__(
+        self, *,
+        state,                                  # TrainState
+        train_step: Callable,                   # (state, batch, rng)->...
+        train_loader,
+        eval_step: Optional[Callable] = None,   # (state, batch)->counts
+        eval_loader=None,
+        epochs: int = 1,
+        seed: int = 0,
+        log_every: int = 50,
+        eval_every_epochs: int = 1,
+        save_every_epochs: int = 1,
+        workdir: Optional[str] = None,
+        best_metric: str = "top1",
+        callbacks: Optional[Callbacks] = None,
+        metric_reducer: Optional[Callable[[Dict], Dict]] = None,
+    ):
+        self.state = state
+        self.train_step = train_step
+        self.train_loader = train_loader
+        self.eval_step = eval_step
+        self.eval_loader = eval_loader
+        self.epochs = epochs
+        self.log_every = log_every
+        self.eval_every = eval_every_epochs
+        self.save_every = save_every_epochs
+        self.best_metric = best_metric
+        self.best_value = float("-inf")
+        self.callbacks = callbacks or Callbacks()
+        self.metric_reducer = metric_reducer
+        self.logger = create_logger("dltpu", workdir)
+        self.tb = TensorBoardWriter(workdir)
+        self.meters = MetricLogger()
+        self.rng = rng_mod.host_key(seed)
+        self.epoch = 0
+        self.ckpt = (CheckpointManager(f"{workdir}/ckpt")
+                     if workdir else None)
+
+    # ------------------------------------------------------------- train
+    def train(self) -> Any:
+        if self.ckpt:
+            restored, step = self.ckpt.auto_resume(self.state)
+            if step:
+                self.state = restored
+                steps_per_epoch = max(len(self.train_loader), 1)
+                self.epoch = int(step) // steps_per_epoch
+        self.callbacks.fire("before_train", self)
+        for epoch in range(self.epoch, self.epochs):
+            self.epoch = epoch
+            self.callbacks.fire("before_epoch", self)
+            self._train_one_epoch(epoch)
+            self.callbacks.fire("after_epoch", self)
+            if self.eval_step and self.eval_loader is not None and \
+                    (epoch + 1) % self.eval_every == 0:
+                self.evaluate()
+            if self.ckpt and (epoch + 1) % self.save_every == 0:
+                self._save()
+        self.callbacks.fire("after_train", self)
+        self.tb.close()
+        return self.state
+
+    def _train_one_epoch(self, epoch: int) -> None:
+        self.train_loader.set_epoch(epoch)
+        t_data = time.time()
+        for it, batch in enumerate(self.train_loader):
+            data_time = time.time() - t_data
+            self.callbacks.fire("before_iter", self, batch=batch)
+            self.state, metrics = self.train_step(self.state, batch,
+                                                  self.rng)
+            self.callbacks.fire("after_iter", self, metrics=metrics)
+            if it % self.log_every == 0:
+                # scalar fetch both syncs and feeds the meters
+                host = {k: float(v) for k, v in metrics.items()}
+                host["data_time"] = data_time
+                self.meters.update(**host)
+                step = int(self.state.step)
+                self.logger.info(
+                    f"epoch {epoch} it {it}/{len(self.train_loader)} "
+                    f"{self.meters}")
+                self.tb.add_scalars(
+                    {f"train/{k}": v for k, v in host.items()}, step)
+            t_data = time.time()
+
+    # -------------------------------------------------------------- eval
+    def evaluate(self) -> Dict[str, float]:
+        totals: Dict[str, float] = defaultdict(float)
+        for batch in self.eval_loader:
+            counts = self.eval_step(self.state, batch)
+            for k, v in counts.items():
+                totals[k] += float(v)
+        results = dict(totals)
+        if self.metric_reducer:
+            results = self.metric_reducer(results)
+        elif "count" in totals and totals["count"] > 0:
+            results = {k: v / totals["count"] for k, v in totals.items()
+                       if k != "count"}
+        self.callbacks.fire("on_evaluate", self, results=results)
+        self.logger.info(f"eval @ epoch {self.epoch}: "
+                         + "  ".join(f"{k}={v:.4f}"
+                                     for k, v in results.items()))
+        self.tb.add_scalars({f"eval/{k}": v for k, v in results.items()},
+                            int(self.state.step))
+        value = results.get(self.best_metric)
+        if value is not None and value > self.best_value:
+            self.best_value = value
+            if self.ckpt:
+                self._save(is_best=True)
+        return results
+
+    def _save(self, is_best: bool = False) -> None:
+        step = int(self.state.step)
+        self.ckpt.save(step, self.state,
+                       metrics={self.best_metric: self.best_value},
+                       is_best=is_best)
+        self.callbacks.fire("on_checkpoint", self, step=step)
+
+    # -------------------------------------------------- throughput mode
+    def throughput(self, n_iters: int = 30) -> float:
+        """images/sec over n averaged iters (swin main.py:281-300)."""
+        it = iter(self.train_loader)
+        batch = next(it)
+        bsz = jax.tree.leaves(batch)[0].shape[0]
+        self.state, m = self.train_step(self.state, batch, self.rng)
+        float(m["loss"])                      # sync
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            self.state, m = self.train_step(self.state, batch, self.rng)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / n_iters
+        ips = bsz / dt
+        self.logger.info(f"throughput: {ips:.1f} images/s "
+                         f"({dt * 1e3:.1f} ms/iter, batch {bsz})")
+        return ips
