@@ -1,0 +1,1 @@
+from . import custom_call, serialize  # noqa: F401

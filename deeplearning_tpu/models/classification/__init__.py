@@ -1,2 +1,2 @@
 from . import (cnns, convnext, lenet, mobile, repvgg, resnet, swin,  # noqa: F401
-               vit)  # import registers factories
+               transfg, vit)  # import registers factories

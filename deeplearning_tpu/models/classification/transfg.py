@@ -1,0 +1,118 @@
+"""TransFG: ViT for fine-grained recognition with part selection.
+
+Surface of classification/TransFG (models/transfg.py: ViT trunk whose
+last block consumes only the tokens with highest accumulated attention to
+the CLS token — part selection via attention rollout — plus a contrastive
+loss on the CLS embedding, losses/contrastive_loss.py). Built on the
+shared ViT blocks; attention maps are recomputed cheaply for rollout
+(static shapes, no hooks needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from .vit import Block, Mlp, PatchEmbed
+
+
+class AttnWithMap(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        b, n, c = x.shape
+        d = c // self.num_heads
+        qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, n, 3, self.num_heads, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q * d ** -0.5, k)
+        attn = jax.nn.softmax(s.astype(jnp.float32), -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn.astype(v.dtype), v)
+        out = nn.Dense(c, dtype=self.dtype, name="proj")(
+            out.reshape(b, n, c))
+        return out, attn
+
+
+class TransFGBlock(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        y, attn = AttnWithMap(self.num_heads, self.dtype, name="attn")(
+            nn.LayerNorm(dtype=self.dtype, name="norm1")(x), deterministic)
+        x = x + y
+        y = Mlp(4.0, 0.0, self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=self.dtype, name="norm2")(x), deterministic)
+        return x + y, attn
+
+
+class TransFG(nn.Module):
+    num_classes: int = 200
+    patch_size: int = 16
+    embed_dim: int = 384
+    depth: int = 8
+    num_heads: int = 6
+    num_parts: int = 12
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        deterministic = not train
+        x = PatchEmbed(self.patch_size, self.embed_dim, self.dtype,
+                       name="patch_embed")(x.astype(self.dtype))
+        b, n, c = x.shape
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, c),
+                         jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (b, 1, c)), x], 1)
+        pos = self.param("pos_embed", nn.initializers.truncated_normal(0.02),
+                         (1, n + 1, c), jnp.float32)
+        x = x + pos.astype(x.dtype)
+
+        rollout = None          # accumulated CLS->patch attention
+        for i in range(self.depth - 1):
+            x, attn = TransFGBlock(self.num_heads, self.dtype,
+                                   name=f"block{i}")(x, deterministic)
+            cls_attn = jnp.mean(attn[:, :, 0, 1:], axis=1)   # (B, N)
+            rollout = cls_attn if rollout is None else rollout * cls_attn
+
+        # part selection: keep top-k informative patch tokens + CLS
+        k = min(self.num_parts, n)
+        _, top_idx = jax.lax.top_k(rollout, k)               # (B, k)
+        parts = jnp.take_along_axis(x[:, 1:], top_idx[:, :, None], axis=1)
+        x = jnp.concatenate([x[:, :1], parts], axis=1)
+        x, _ = TransFGBlock(self.num_heads, self.dtype,
+                            name=f"block{self.depth - 1}")(x, deterministic)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        embedding = x[:, 0].astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x[:, 0]).astype(jnp.float32)
+        return {"logits": logits, "embedding": embedding}
+
+
+def contrastive_loss(embeddings: jax.Array, labels: jax.Array,
+                     margin: float = 0.4) -> jax.Array:
+    """TransFG contrastive loss (losses/contrastive_loss.py): pull same-
+    class CLS embeddings together, push different-class pairs past a
+    cosine margin."""
+    z = embeddings / (jnp.linalg.norm(embeddings, axis=-1,
+                                      keepdims=True) + 1e-12)
+    sim = z @ z.T
+    same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    eye = jnp.eye(len(labels))
+    pos_loss = jnp.sum((1 - sim) * same * (1 - eye))
+    neg_loss = jnp.sum(jnp.maximum(sim - margin, 0.0) * (1 - same))
+    denom = len(labels) * (len(labels) - 1)
+    return (pos_loss + neg_loss) / max(denom, 1)
+
+
+@MODELS.register("transfg_small")
+def transfg_small(num_classes: int = 200, **kw):
+    return TransFG(num_classes=num_classes, **kw)

@@ -1,1 +1,1 @@
-from . import faster_rcnn, fcos, fpn, retinanet, yolox  # noqa: F401
+from . import faster_rcnn, fcos, fpn, retinanet, yolov5, yolox  # noqa: F401

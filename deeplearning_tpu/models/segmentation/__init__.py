@@ -1,1 +1,1 @@
-from . import fcn_deeplab, hrnet, unet  # noqa: F401
+from . import fcn_deeplab, fewshot, hrnet, unet  # noqa: F401

@@ -1,0 +1,94 @@
+"""Few-shot segmentation: SSP (self-support prototypes).
+
+Surface of Image_segmentation/few_shot_segmentation (models/sspnet.py:
+support/query episodes, masked average pooling of support features into
+fg/bg prototypes, cosine-similarity matching, self-support refinement —
+query pixels confidently matched become additional prototypes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from ..classification.resnet import ResNet
+
+
+def masked_average_pool(feats: jax.Array, mask: jax.Array) -> jax.Array:
+    """(B, H, W, C) features + (B, H, W) {0,1} mask → (B, C) prototype."""
+    m = mask[..., None].astype(feats.dtype)
+    return jnp.sum(feats * m, axis=(1, 2)) / jnp.maximum(
+        jnp.sum(m, axis=(1, 2)), 1e-6)
+
+
+def cosine_similarity_map(feats: jax.Array, proto: jax.Array) -> jax.Array:
+    """(B, H, W, C) × (B, C) → (B, H, W) cosine similarity."""
+    f = feats / (jnp.linalg.norm(feats, axis=-1, keepdims=True) + 1e-6)
+    p = proto / (jnp.linalg.norm(proto, axis=-1, keepdims=True) + 1e-6)
+    return jnp.einsum("bhwc,bc->bhw", f, p)
+
+
+class SSPNet(nn.Module):
+    """1-way k-shot episode segmenter."""
+    backbone_sizes: Tuple[int, ...] = (2, 2, 2, 2)
+    refine_thresh_fg: float = 0.7
+    refine_thresh_bg: float = 0.6
+    dtype: Any = jnp.bfloat16
+
+    def setup(self):
+        self.encoder = ResNet(stage_sizes=self.backbone_sizes,
+                              block="basic", return_features=True,
+                              dtype=self.dtype, name="encoder")
+
+    def encode(self, x, train: bool = False):
+        return self.encoder(x, train=train)["c4"]     # stride 16 features
+
+    def __call__(self, support_img, support_mask, query_img,
+                 train: bool = False):
+        """support_img (B, S, H, W, 3); support_mask (B, S, H, W);
+        query (B, H, W, 3) → logits (B, H, W, 2)."""
+        b, s = support_img.shape[:2]
+        sup = self.encode(support_img.reshape((-1,) + support_img.shape[2:]),
+                          train)
+        _, fh, fw, c = sup.shape
+        sup = sup.reshape(b, s, fh, fw, c)
+        m = jax.image.resize(support_mask.astype(jnp.float32),
+                             (b, s, fh, fw), "nearest")
+        # k-shot prototypes averaged over shots
+        fg_proto = masked_average_pool(
+            sup.reshape(b * s, fh, fw, c),
+            m.reshape(b * s, fh, fw)).reshape(b, s, c).mean(1)
+        bg_proto = masked_average_pool(
+            sup.reshape(b * s, fh, fw, c),
+            1 - m.reshape(b * s, fh, fw)).reshape(b, s, c).mean(1)
+
+        q = self.encode(query_img, train)
+        fg_sim = cosine_similarity_map(q, fg_proto)
+        bg_sim = cosine_similarity_map(q, bg_proto)
+
+        # self-support refinement: confident query pixels augment protos
+        conf_fg = (fg_sim > self.refine_thresh_fg).astype(jnp.float32)
+        conf_bg = (bg_sim > self.refine_thresh_bg).astype(jnp.float32)
+        ssp_fg = masked_average_pool(q, conf_fg)
+        ssp_bg = masked_average_pool(q, conf_bg)
+        has_fg = (jnp.sum(conf_fg, axis=(1, 2)) > 0)[:, None]
+        has_bg = (jnp.sum(conf_bg, axis=(1, 2)) > 0)[:, None]
+        fg_proto = jnp.where(has_fg, 0.5 * fg_proto + 0.5 * ssp_fg,
+                             fg_proto)
+        bg_proto = jnp.where(has_bg, 0.5 * bg_proto + 0.5 * ssp_bg,
+                             bg_proto)
+        fg_sim = cosine_similarity_map(q, fg_proto)
+        bg_sim = cosine_similarity_map(q, bg_proto)
+
+        logits = jnp.stack([bg_sim, fg_sim], axis=-1) * 10.0   # temp
+        bq, hq, wq, _ = query_img.shape
+        return jax.image.resize(logits, (bq, hq, wq, 2), "bilinear")
+
+
+@MODELS.register("sspnet_resnet18")
+def sspnet_resnet18(**kw):
+    return SSPNet(**kw)

@@ -1,0 +1,213 @@
+"""MADNet: real-time self-adaptive stereo depth + MAD online adaptation.
+
+Surface of deep_stereo/Real_time_self_adaptive_depp_stereo: MadNet
+(models/MadNet.py — 6-level pyramid towers, correlation-based disparity
+estimation per level, warping refinement), the photometric reprojection +
+SSIM loss (losses/loss_factory.py), and the repo's only ONLINE training
+loop (Stereo_Online_Adaptation.py:43-44 modes NONE/FULL/MAD with
+reward-softmax block sampling :197-241; Sampler/sampler_factory.py:5-82).
+
+TPU-first: the MAD trick (backprop only a sampled portion of the net per
+frame) maps to per-module gradient gating masks — one jitted step serves
+all modes; the probabilistic sampler lives host-side and feeds a
+mask pytree (no retracing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import MODELS
+
+
+def warp_right_to_left(right: jax.Array, disparity: jax.Array) -> jax.Array:
+    """Sample right image at x - d (bilinear along x)."""
+    b, h, w, c = right.shape
+    xs = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+    src = xs - disparity[..., 0]
+    x0 = jnp.clip(jnp.floor(src), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wx = src - x0
+    x0i = x0.astype(jnp.int32)
+    x1i = x1.astype(jnp.int32)
+    batch_idx = jnp.arange(b)[:, None, None]
+    row_idx = jnp.arange(h)[None, :, None]
+    v0 = right[batch_idx, row_idx, x0i]
+    v1 = right[batch_idx, row_idx, x1i]
+    out = v0 * (1 - wx[..., None]) + v1 * wx[..., None]
+    valid = (src >= 0) & (src <= w - 1)
+    return out * valid[..., None]
+
+
+def correlation_1d(left: jax.Array, right: jax.Array,
+                   max_disp: int = 8) -> jax.Array:
+    """Horizontal correlation volume (MadNet cost volume)."""
+    b, h, w, c = left.shape
+    costs = []
+    for d in range(max_disp + 1):
+        shifted = jnp.pad(right, ((0, 0), (0, 0), (d, 0), (0, 0)))[:, :, :w]
+        costs.append(jnp.mean(left * shifted, axis=-1))
+    return jnp.stack(costs, axis=-1)
+
+
+class PyramidTower(nn.Module):
+    """Shared feature pyramid (6 levels, stride 2 each)."""
+    widths: Sequence[int] = (16, 32, 64, 96, 128, 192)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        feats = []
+        for i, wdt in enumerate(self.widths):
+            x = nn.Conv(wdt, (3, 3), strides=(2, 2), padding="SAME",
+                        dtype=self.dtype, name=f"conv{i}a")(x)
+            x = nn.leaky_relu(x, 0.2)
+            x = nn.Conv(wdt, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"conv{i}b")(x)
+            x = nn.leaky_relu(x, 0.2)
+            feats.append(x)
+        return feats
+
+
+class DispEstimator(nn.Module):
+    """Per-level disparity decoder over [corr, left_feat, up_disp]."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for i, wdt in enumerate((128, 128, 96, 64, 32)):
+            x = nn.Conv(wdt, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"c{i}")(x)
+            x = nn.leaky_relu(x, 0.2)
+        return nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
+                       name="pred")(x).astype(jnp.float32)
+
+
+class MADNet(nn.Module):
+    max_disp: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, left: jax.Array, right: jax.Array,
+                 train: bool = False) -> Dict[str, Any]:
+        tower = PyramidTower(dtype=self.dtype, name="tower")
+        lf = tower(left.astype(self.dtype))
+        rf = tower(right.astype(self.dtype))
+        disparities: List[jax.Array] = []
+        disp = None
+        # coarse-to-fine from the deepest level (module names D6..D2 match
+        # the reference's per-block MAD sampling granularity)
+        for li in reversed(range(1, len(lf))):
+            l_feat, r_feat = lf[li], rf[li]
+            if disp is not None:
+                b, h, w, _ = l_feat.shape
+                disp_up = jax.image.resize(disp, (b, h, w, 1),
+                                           "bilinear") * 2.0
+                r_feat = warp_right_to_left(r_feat, disp_up)
+            else:
+                disp_up = jnp.zeros(l_feat.shape[:3] + (1,), jnp.float32)
+            corr = correlation_1d(l_feat.astype(jnp.float32),
+                                  r_feat.astype(jnp.float32),
+                                  self.max_disp)
+            inp = jnp.concatenate(
+                [corr.astype(self.dtype), l_feat, disp_up.astype(
+                    self.dtype)], axis=-1)
+            residual = DispEstimator(self.dtype, name=f"D{li + 1}")(inp)
+            disp = nn.relu(disp_up + residual)
+            disparities.append(disp)
+        b, h, w, _ = left.shape
+        # finest loop level sits at stride 4: the 4x spatial upsample must
+        # scale disparity values by 4 as well
+        full = jax.image.resize(disp, (b, h, w, 1), "bilinear") * 4.0
+        return {"disparity": full, "pyramid": disparities}
+
+
+def photometric_loss(left: jax.Array, right: jax.Array,
+                     disparity: jax.Array, alpha: float = 0.85
+                     ) -> jax.Array:
+    """SSIM + L1 reprojection loss (losses/loss_factory.py surface)."""
+    warped = warp_right_to_left(right, disparity)
+    l1 = jnp.abs(left - warped)
+    # simplified 3x3 SSIM
+    def pool(x):
+        return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    mu_x = pool(left)
+    mu_y = pool(warped)
+    sx = pool(left ** 2) - mu_x ** 2
+    sy = pool(warped ** 2) - mu_y ** 2
+    sxy = pool(left * warped) - mu_x * mu_y
+    c1, c2 = 0.01 ** 2, 0.03 ** 2
+    ssim = ((2 * mu_x * mu_y + c1) * (2 * sxy + c2)) / (
+        (mu_x ** 2 + mu_y ** 2 + c1) * (sx + sy + c2))
+    dssim = jnp.clip((1 - ssim) / 2, 0, 1)
+    return jnp.mean(alpha * dssim + (1 - alpha) * l1)
+
+
+class MADSampler:
+    """Reward-softmax block selection (Stereo_Online_Adaptation.py:197-241
+    + sampler_factory.py): keeps a score per trainable block, samples
+    which blocks to adapt this frame, updates scores from the loss
+    improvement. Host-side; emits a gradient gating mask pytree."""
+
+    def __init__(self, block_names: Sequence[str], sample_n: int = 2,
+                 temperature: float = 1.0, ema: float = 0.99,
+                 mode: str = "probabilistic", seed: int = 0):
+        self.blocks = list(block_names)
+        self.scores = np.zeros(len(self.blocks))
+        self.sample_n = sample_n
+        self.temperature = temperature
+        self.ema = ema
+        self.mode = mode
+        self.rng = np.random.default_rng(seed)
+        self.last_loss: Optional[float] = None
+        self._round_robin = 0
+
+    def sample(self) -> List[str]:
+        if self.mode == "full":
+            return list(self.blocks)
+        if self.mode == "none":
+            return []
+        if self.mode == "sequential":
+            sel = [self.blocks[self._round_robin % len(self.blocks)]]
+            self._round_robin += 1
+            return sel
+        if self.mode == "argmax":
+            order = np.argsort(-self.scores)
+            return [self.blocks[i] for i in order[:self.sample_n]]
+        if self.mode == "random":
+            idx = self.rng.choice(len(self.blocks), self.sample_n,
+                                  replace=False)
+            return [self.blocks[i] for i in idx]
+        # probabilistic (reward softmax)
+        p = np.exp(self.scores / self.temperature)
+        p = p / p.sum()
+        idx = self.rng.choice(len(self.blocks), self.sample_n,
+                              replace=False, p=p)
+        return [self.blocks[i] for i in idx]
+
+    def update(self, selected: Sequence[str], loss: float) -> None:
+        if self.last_loss is not None:
+            reward = self.last_loss - loss         # improvement
+            for name in selected:
+                i = self.blocks.index(name)
+                self.scores[i] = self.ema * self.scores[i] \
+                    + (1 - self.ema) * reward
+        self.last_loss = loss
+
+    def grad_mask(self, params, selected: Sequence[str]):
+        """1/0 mask pytree: gradients flow only into selected top-level
+        blocks (the MAD partial-backprop trick as a multiply)."""
+        sel = set(selected)
+        return {k: jax.tree.map(
+            lambda _: 1.0 if k in sel else 0.0, v)
+            for k, v in params.items()}
+
+
+@MODELS.register("madnet")
+def madnet(**kw):
+    return MADNet(**kw)

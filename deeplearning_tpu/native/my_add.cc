@@ -1,0 +1,30 @@
+// XLA FFI custom-call demo — the TPU-era analog of the reference's
+// custom-op tutorial (others/deploy/pytorch2onnx/my_add.cpp:5-12, which
+// registers `3a + 2b` as a torch extension and exports it to ONNX via
+// g.op symbolic registration). Here the same toy op is an XLA FFI
+// handler: compiled with the jaxlib headers, registered on the Host
+// platform, and invoked from JAX via jax.ffi.ffi_call — demonstrating
+// the full "teach XLA a new op" path (export/custom_call.py wires it).
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error MyAddImpl(ffi::Buffer<ffi::F32> a,
+                            ffi::Buffer<ffi::F32> b,
+                            ffi::ResultBuffer<ffi::F32> out) {
+  const int64_t n = static_cast<int64_t>(a.element_count());
+  const float* pa = a.typed_data();
+  const float* pb = b.typed_data();
+  float* po = out->typed_data();
+  for (int64_t i = 0; i < n; ++i) po[i] = 3.0f * pa[i] + 2.0f * pb[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(MyAdd, MyAddImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

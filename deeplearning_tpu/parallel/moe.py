@@ -1,0 +1,125 @@
+"""Mixture-of-Experts MLP with expert parallelism over the mesh.
+
+Surface of classification/swin_transformer/models/swin_transformer_moe.py
+(:36 MoEMlp → tutel moe_layer with top-k cosine router, capacity factor
+:273, aux load-balance loss; :705 global experts = local × world_size).
+TPU-native design: the tutel all-to-all dispatch becomes einsum dispatch/
+combine tensors under GSPMD — expert parameters carry a leading E axis
+sharded over the ``expert`` mesh axis, tokens are sharded over ``data``,
+and XLA inserts the all-to-alls from the shardings. Capacity-limited
+top-k routing with dropped-token passthrough, fully static shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .mesh import EXPERT_AXIS
+from .sharding import Rules
+from jax.sharding import PartitionSpec as P
+
+# sharding rules for MoE params: expert-major leading axis
+MOE_RULES: Rules = (
+    (r"experts/(fc1|fc2)_kernel$", P(EXPERT_AXIS, None, None)),
+    (r"experts/(fc1|fc2)_bias$", P(EXPERT_AXIS, None)),
+)
+
+
+def load_balance_loss(router_probs: jax.Array, expert_mask: jax.Array
+                      ) -> jax.Array:
+    """Switch-style aux loss: E · dot(mean prob per expert, fraction of
+    tokens per expert)."""
+    e = router_probs.shape[-1]
+    density = jnp.mean(expert_mask, axis=0)          # tokens fraction
+    density_proxy = jnp.mean(router_probs, axis=0)   # prob mass
+    return e * jnp.sum(density * density_proxy)
+
+
+class ExpertMlp(nn.Module):
+    """E parallel MLPs as batched params (leading E axis → shardable)."""
+    num_experts: int
+    hidden: int
+    out_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):            # x: (E, C, D)
+        d = x.shape[-1]
+        k1 = self.param("fc1_kernel", nn.initializers.lecun_normal(),
+                        (self.num_experts, d, self.hidden), jnp.float32)
+        b1 = self.param("fc1_bias", nn.initializers.zeros,
+                        (self.num_experts, self.hidden), jnp.float32)
+        k2 = self.param("fc2_kernel", nn.initializers.lecun_normal(),
+                        (self.num_experts, self.hidden, self.out_dim),
+                        jnp.float32)
+        b2 = self.param("fc2_bias", nn.initializers.zeros,
+                        (self.num_experts, self.out_dim), jnp.float32)
+        y = jnp.einsum("ecd,edh->ech", x, k1.astype(x.dtype)) \
+            + b1[:, None].astype(x.dtype)
+        y = nn.gelu(y, approximate=True)
+        y = jnp.einsum("ech,eho->eco", y, k2.astype(x.dtype)) \
+            + b2[:, None].astype(x.dtype)
+        return y
+
+
+class MoEMlp(nn.Module):
+    """Drop-in MLP replacement with top-k capacity-limited routing.
+
+    Returns (output, aux_loss). Dropped tokens pass through as zeros plus
+    the residual connection outside handles them (swin-moe behavior).
+    """
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    hidden_ratio: float = 4.0
+    aux_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True
+                 ) -> Tuple[jax.Array, jax.Array]:
+        b, n, d = x.shape
+        t = b * n
+        tokens = x.reshape(t, d)
+        e = self.num_experts
+        capacity = max(int(t / e * self.capacity_factor * self.top_k), 1)
+
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        combine = jnp.zeros((t, e, capacity), jnp.float32)
+        aux = jnp.zeros((), jnp.float32)
+        remaining = probs
+        used = jnp.zeros((e,), jnp.float32)   # slots taken in prior rounds
+        for k in range(self.top_k):
+            choice = jnp.argmax(remaining, axis=-1)              # (T,)
+            gate = jnp.take_along_axis(remaining, choice[:, None],
+                                       axis=-1)[:, 0]
+            mask = jax.nn.one_hot(choice, e)                     # (T, E)
+            if k == 0:
+                aux = load_balance_loss(probs, mask)
+            # position within expert (capacity rank), in token order,
+            # OFFSET by slots consumed in earlier top-k rounds so first-
+            # and second-choice tokens never collide on a slot
+            pos = (jnp.cumsum(mask, axis=0) - 1.0 + used[None, :]) * mask
+            in_cap = pos < capacity
+            pos_idx = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+            cap_onehot = jax.nn.one_hot(pos_idx, capacity) \
+                * (mask * in_cap)[..., None]                     # (T,E,C)
+            combine = combine + gate[:, None, None] * cap_onehot
+            used = used + jnp.sum(mask, axis=0)
+            remaining = remaining * (1.0 - mask)
+
+        dispatch = (combine > 0).astype(tokens.dtype)            # (T,E,C)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
+        expert_out = ExpertMlp(e, int(d * self.hidden_ratio), d,
+                               self.dtype, name="experts")(expert_in)
+        out = jnp.einsum("tec,eco->to", combine.astype(expert_out.dtype),
+                         expert_out)
+        return out.reshape(b, n, d), self.aux_weight * aux

@@ -1,0 +1,1 @@
+from . import normalization, profiling, visualize  # noqa: F401

@@ -1,0 +1,56 @@
+"""Normalization layers from scratch — the teaching module.
+
+Surface of others/normalization (batch_normalization.py,
+layer_normalization.py, instance_normalization.py,
+group_normalization.py): each norm written out as explicit mean/var math
+over its reduction axes, for study and as golden references against the
+flax implementations (tests compare them).
+
+Axes cheat-sheet for NHWC:
+  BatchNorm:    reduce (N, H, W)  per channel
+  LayerNorm:    reduce (C,) [or (H, W, C)] per sample position
+  InstanceNorm: reduce (H, W)     per sample per channel
+  GroupNorm:    reduce (H, W, C/G) per sample per group
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x, gamma, beta, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    return gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+
+
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+
+
+def instance_norm(x, gamma, beta, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+    var = jnp.var(x, axis=(1, 2), keepdims=True)
+    return gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+
+
+def group_norm(x, gamma, beta, groups: int, eps: float = 1e-5):
+    b, h, w, c = x.shape
+    g = x.reshape(b, h, w, groups, c // groups)
+    mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(g, axis=(1, 2, 4), keepdims=True)
+    g = (g - mean) / jnp.sqrt(var + eps)
+    return gamma * g.reshape(b, h, w, c) + beta
+
+
+def sync_batch_norm_stats(x, axis_name: str):
+    """Cross-replica BN statistics via pmean — what SyncBatchNorm does
+    (others/train_with_DDP/train.py:192). Inside pjit/GSPMD this is
+    automatic; this explicit version is for shard_map code."""
+    mean = jax.lax.pmean(jnp.mean(x, axis=(0, 1, 2)), axis_name)
+    mean2 = jax.lax.pmean(jnp.mean(jnp.square(x), axis=(0, 1, 2)),
+                          axis_name)
+    return mean, mean2 - jnp.square(mean)

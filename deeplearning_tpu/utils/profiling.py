@@ -1,0 +1,86 @@
+"""Profiling: step timing, XLA-FLOPs MFU meter, jax.profiler traces.
+
+The reference's ad-hoc timing stack (SURVEY.md §5: cuda-synchronized
+time_sync, thop-based layer profilers, swin throughput mode) becomes:
+- ``StepTimer``: wall-clock per-step timing synced by scalar D2H fetch
+  (block_until_ready is unreliable on remote-tunnel backends).
+- ``mfu``: measured step time vs compiled-graph FLOPs vs chip peak — the
+  BASELINE.md headline metric.
+- ``trace``: context manager around jax.profiler for TensorBoard's
+  profile plugin.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+
+PEAK_BF16_FLOPS = {
+    "v6": 918e12, "v5p": 459e12, "v5": 197e12, "v4": 275e12,
+    "v3": 123e12, "v2": 45e12,
+}
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+class StepTimer:
+    """Accumulates step wall times; caller syncs via the returned scalar."""
+
+    def __init__(self):
+        self.times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        self.times.append(time.perf_counter() - self._t0)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)
+
+
+def compiled_flops(fn: Callable, *args) -> float:
+    cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+    return float(cost.get("flops", 0.0)) if cost else 0.0
+
+
+def measure_mfu(step_fn: Callable, args: tuple, n_steps: int = 10,
+                sync_fetch: Callable = None) -> Dict[str, float]:
+    """Run ``step_fn(*args)`` n times, sync by fetching a scalar from the
+    output (sync_fetch(output) -> float), report step time + MFU."""
+    flops = compiled_flops(step_fn, *args)
+    out = step_fn(*args)
+    if sync_fetch:
+        sync_fetch(out)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out = step_fn(*args)
+    if sync_fetch:
+        sync_fetch(out)
+    dt = (time.perf_counter() - t0) / n_steps
+    peak = device_peak_flops()
+    return {"step_time_s": dt, "flops_per_step": flops,
+            "mfu": flops / dt / peak if flops else 0.0,
+            "peak_flops": peak}
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace for TensorBoard's profile plugin."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

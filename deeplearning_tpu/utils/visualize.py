@@ -1,0 +1,108 @@
+"""Feature-map / kernel / prediction visualization.
+
+Surface of others/visual_weight_feature_map_test
+(visual_feature_map.py:66 truncated-model per-channel plots,
+visual_kernel_weight.py:23 conv-kernel grids), tensorboard_test's figure
+helpers, and the detection demo drawing (yolov5 utils/plots.py). Pure
+numpy → (H, W, 3) uint8 images that feed TensorBoardWriter.add_image or
+PIL. Capturing intermediates uses flax's capture_intermediates — no
+forward hooks needed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _to_grid(tiles: np.ndarray, pad: int = 1) -> np.ndarray:
+    """(N, H, W) → one (rows·H, cols·W) grid image, normalized per tile."""
+    n, h, w = tiles.shape
+    cols = int(np.ceil(np.sqrt(n)))
+    rows = int(np.ceil(n / cols))
+    canvas = np.zeros((rows * (h + pad), cols * (w + pad)), np.float32)
+    for i in range(n):
+        t = tiles[i]
+        lo, hi = t.min(), t.max()
+        t = (t - lo) / (hi - lo + 1e-9)
+        r, c = divmod(i, cols)
+        canvas[r * (h + pad):r * (h + pad) + h,
+               c * (w + pad):c * (w + pad) + w] = t
+    return canvas
+
+
+def feature_map_grid(features: np.ndarray, max_channels: int = 64
+                     ) -> np.ndarray:
+    """(H, W, C) activation → uint8 grid of the first C channels."""
+    f = np.asarray(features, np.float32)
+    f = np.moveaxis(f, -1, 0)[:max_channels]
+    return (255 * _to_grid(f)).astype(np.uint8)
+
+
+def kernel_grid(kernel: np.ndarray, max_kernels: int = 64) -> np.ndarray:
+    """(kh, kw, cin, cout) conv kernel → uint8 grid (input-channel mean)."""
+    k = np.asarray(kernel, np.float32).mean(axis=2)     # (kh, kw, cout)
+    k = np.moveaxis(k, -1, 0)[:max_kernels]
+    return (255 * _to_grid(k, pad=1)).astype(np.uint8)
+
+
+def capture_feature_maps(model, variables, x, filter_fn=None
+                         ) -> Dict[str, np.ndarray]:
+    """Run the model capturing every module's output (the truncated-model
+    forward of visual_feature_map.py, but via capture_intermediates)."""
+    _, mods = model.apply(variables, x, train=False,
+                          capture_intermediates=filter_fn or True)
+    flat = {}
+
+    def walk(tree, prefix=""):
+        for k, v in tree.items():
+            path = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, path)
+            else:
+                arr = v[0] if isinstance(v, tuple) else v
+                flat[path] = np.asarray(arr)
+    walk(mods["intermediates"])
+    return flat
+
+
+def draw_boxes(image: np.ndarray, boxes: np.ndarray,
+               labels: Optional[Sequence] = None,
+               scores: Optional[np.ndarray] = None,
+               color: Tuple[int, int, int] = (0, 255, 0),
+               thickness: int = 2) -> np.ndarray:
+    """Draw xyxy boxes on a uint8 image (detection demo rendering)."""
+    img = np.ascontiguousarray(np.asarray(image, np.uint8).copy())
+    for i, box in enumerate(np.asarray(boxes)):
+        x1, y1, x2, y2 = (int(round(float(v))) for v in box)
+        x1, x2 = np.clip([x1, x2], 0, img.shape[1] - 1)
+        y1, y2 = np.clip([y1, y2], 0, img.shape[0] - 1)
+        img[y1:y1 + thickness, x1:x2] = color
+        img[max(y2 - thickness, 0):y2, x1:x2] = color
+        img[y1:y2, x1:x1 + thickness] = color
+        img[y1:y2, max(x2 - thickness, 0):x2] = color
+    return img
+
+
+def confusion_matrix_figure(matrix: np.ndarray,
+                            class_names: Sequence[str]):
+    """matplotlib figure of a confusion matrix (tensorboard_test
+    add_figure tour); returns None when matplotlib is missing."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    fig, ax = plt.subplots(figsize=(6, 6))
+    ax.imshow(matrix, cmap="Blues")
+    ax.set_xticks(range(len(class_names)), class_names, rotation=45)
+    ax.set_yticks(range(len(class_names)), class_names)
+    ax.set_xlabel("predicted")
+    ax.set_ylabel("true")
+    for i in range(len(class_names)):
+        for j in range(len(class_names)):
+            ax.text(j, i, f"{matrix[i, j]:.0f}", ha="center", va="center")
+    fig.tight_layout()
+    return fig

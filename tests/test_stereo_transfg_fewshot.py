@@ -1,0 +1,141 @@
+"""MADNet stereo + online adaptation, TransFG, few-shot segmentation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.models.stereo.madnet import (MADSampler,
+                                                   correlation_1d,
+                                                   photometric_loss,
+                                                   warp_right_to_left)
+
+
+class TestMADNet:
+    def test_warp_shifts_image(self):
+        right = jnp.zeros((1, 4, 8, 1)).at[:, :, 4, :].set(1.0)
+        disp = jnp.full((1, 4, 8, 1), 2.0)
+        warped = warp_right_to_left(right, disp)
+        # pixel at x=6 samples right at x-2=4 -> sees the bright column
+        assert float(warped[0, 0, 6, 0]) == pytest.approx(1.0)
+        assert float(warped[0, 0, 4, 0]) == pytest.approx(0.0)
+
+    def test_correlation_volume(self):
+        l = jnp.ones((1, 4, 8, 3))
+        r = jnp.ones((1, 4, 8, 3))
+        corr = correlation_1d(l, r, max_disp=3)
+        assert corr.shape == (1, 4, 8, 4)
+        assert float(corr[0, 0, 7, 0]) == pytest.approx(1.0)
+
+    def test_forward_and_photometric_loss(self):
+        model = MODELS.build("madnet", dtype=jnp.float32)
+        left = jnp.asarray(np.random.default_rng(0).normal(
+            0, 1, (1, 64, 64, 3)), jnp.float32)
+        right = jnp.roll(left, -3, axis=2)   # true disparity 3
+        variables = model.init(jax.random.key(0), left, right)
+        out = model.apply(variables, left, right)
+        assert out["disparity"].shape == (1, 64, 64, 1)
+        assert (np.asarray(out["disparity"]) >= 0).all()
+        loss = photometric_loss(left, right, out["disparity"])
+        assert np.isfinite(float(loss))
+
+    def test_online_adaptation_reduces_loss(self):
+        model = MODELS.build("madnet", dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        base = rng.normal(0, 1, (1, 32, 64, 3)).astype(np.float32)
+        left = jnp.asarray(base)
+        right = jnp.asarray(np.roll(base, -2, axis=2))
+        variables = model.init(jax.random.key(0), left, right)
+        params = variables["params"]
+        tx = optax.adam(1e-4)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, mask):
+            def lf(p):
+                out = model.apply({"params": p}, left, right)
+                return photometric_loss(left, right, out["disparity"])
+            loss, g = jax.value_and_grad(lf)(params)
+            g = jax.tree.map(lambda gg, m: gg * m, g, mask)
+            up, opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, up), opt, loss
+
+        sampler = MADSampler([k for k in params], sample_n=2,
+                             mode="probabilistic")
+        first = None
+        for _ in range(12):
+            selected = sampler.sample()
+            mask = sampler.grad_mask(params, selected)
+            params, opt, loss = step(params, opt, mask)
+            sampler.update(selected, float(loss))
+            first = first or float(loss)
+        assert float(loss) <= first           # adapting, not diverging
+        # only selected blocks' params changed in the last step
+        assert len(selected) == 2
+
+    def test_sampler_modes(self):
+        names = ["D2", "D3", "D4", "tower"]
+        for mode in ("full", "none", "random", "argmax", "sequential",
+                     "probabilistic"):
+            s = MADSampler(names, sample_n=2, mode=mode)
+            sel = s.sample()
+            if mode == "full":
+                assert sel == names
+            elif mode == "none":
+                assert sel == []
+            else:
+                assert 1 <= len(sel) <= 2
+        seq = MADSampler(names, mode="sequential")
+        assert [seq.sample()[0] for _ in range(4)] == names
+
+
+class TestTransFG:
+    def test_forward_and_part_selection(self):
+        model = MODELS.build("transfg_small", num_classes=10,
+                             embed_dim=64, depth=3, num_heads=4,
+                             num_parts=5, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            0, 1, (2, 64, 64, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out["logits"].shape == (2, 10)
+        assert out["embedding"].shape == (2, 64)
+
+    def test_contrastive_loss_behavior(self):
+        from deeplearning_tpu.models.classification.transfg import (
+            contrastive_loss)
+        z = jnp.asarray([[1.0, 0], [1.0, 0], [0, 1.0], [0, 1.0]])
+        labels_good = jnp.asarray([0, 0, 1, 1])
+        labels_bad = jnp.asarray([0, 1, 0, 1])
+        good = float(contrastive_loss(z, labels_good))
+        bad = float(contrastive_loss(z, labels_bad))
+        assert good < bad
+
+
+class TestFewShot:
+    def test_episode_segmentation(self):
+        model = MODELS.build("sspnet_resnet18", dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        sup_img = jnp.asarray(rng.normal(0, 1, (1, 2, 32, 32, 3)),
+                              jnp.float32)
+        sup_mask = jnp.zeros((1, 2, 32, 32)).at[:, :, 8:24, 8:24].set(1.0)
+        query = jnp.asarray(rng.normal(0, 1, (1, 32, 32, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), sup_img, sup_mask, query)
+        logits = model.apply(variables, sup_img, sup_mask, query)
+        assert logits.shape == (1, 32, 32, 2)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_prototype_matching_separates_classes(self):
+        from deeplearning_tpu.models.segmentation.fewshot import (
+            cosine_similarity_map, masked_average_pool)
+        feats = jnp.zeros((1, 4, 4, 2))
+        feats = feats.at[:, :2].set(jnp.asarray([1.0, 0]))
+        feats = feats.at[:, 2:].set(jnp.asarray([0, 1.0]))
+        mask = jnp.zeros((1, 4, 4)).at[:, :2].set(1.0)
+        proto = masked_average_pool(feats, mask)
+        np.testing.assert_allclose(np.asarray(proto), [[1.0, 0]], atol=1e-6)
+        sim = cosine_similarity_map(feats, proto)
+        assert float(sim[0, 0, 0]) == pytest.approx(1.0, abs=1e-4)
+        assert float(sim[0, 3, 0]) == pytest.approx(0.0, abs=1e-4)

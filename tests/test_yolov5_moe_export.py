@@ -1,0 +1,163 @@
+"""YOLOv5, MoE layer, export paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.models.detection import yolov5 as Y5
+from deeplearning_tpu.parallel.moe import MoEMlp, MOE_RULES
+
+
+class TestYOLOv5:
+    def test_forward_and_grid(self):
+        model = MODELS.build("yolov5s", num_classes=3, width_mult=0.25,
+                             depth_mult=0.33, dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        raw = model.apply(variables, x, train=False)
+        grid = Y5.yolov5_grid((64, 64))
+        assert raw.shape == (1, len(grid["cell"]), 5 + 3)
+        dec = Y5.decode_yolov5(raw, {k: jnp.asarray(v)
+                                     for k, v in grid.items()})
+        b = np.asarray(dec[0, :, :4])
+        assert (b[:, 2] >= b[:, 0]).all()
+
+    def test_build_targets_and_loss(self):
+        grid = {k: jnp.asarray(v) for k, v in
+                Y5.yolov5_grid((64, 64)).items()}
+        gt_boxes = jnp.asarray([[[8.0, 8, 40, 40]]])
+        gt_labels = jnp.asarray([[1]])
+        gt_valid = jnp.asarray([[True]])
+        tgt = Y5.build_targets(grid, gt_boxes, gt_labels, gt_valid)
+        assert int(tgt["pos"][0].sum()) >= 1
+        # positives' anchors have compatible wh ratio with the 32px gt
+        pos = np.asarray(tgt["pos"][0])
+        anchors = np.asarray(grid["anchor"])[pos]
+        ratio = np.maximum(anchors / 32.0, 32.0 / anchors).max(-1)
+        assert (ratio < 4.0).all()
+
+        raw = jnp.zeros((1, len(grid["cell"]), 5 + 3))
+        losses = Y5.yolov5_loss(raw, grid, gt_boxes, gt_labels, gt_valid,
+                                num_classes=3)
+        for v in losses.values():
+            assert np.isfinite(float(v))
+
+    def test_kmean_anchors(self):
+        rng = np.random.default_rng(0)
+        wh = np.concatenate([rng.normal(32, 4, (100, 2)),
+                             rng.normal(128, 10, (100, 2))])
+        anchors = Y5.kmean_anchors(wh, n=4)
+        assert anchors.shape == (4, 2)
+        areas = anchors.prod(1)
+        assert (np.diff(areas) >= 0).all()       # sorted by area
+        # clusters near the two modes
+        assert abs(anchors[0].mean() - 32) < 15
+        assert abs(anchors[-1].mean() - 128) < 20
+
+    def test_postprocess(self):
+        grid = {k: jnp.asarray(v) for k, v in
+                Y5.yolov5_grid((64, 64)).items()}
+        raw = jnp.asarray(np.random.default_rng(0).normal(
+            0, 1, (1, len(grid["cell"]), 5 + 3)), jnp.float32)
+        det = Y5.yolov5_postprocess(raw, grid, score_thresh=0.0,
+                                    max_det=10)
+        assert det["boxes"].shape == (1, 10, 4)
+
+
+class TestMoE:
+    def test_forward_shapes_and_aux(self):
+        moe = MoEMlp(num_experts=4, top_k=2, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)),
+                        jnp.float32)
+        params = moe.init(jax.random.key(0), x)["params"]
+        out, aux = moe.apply({"params": params}, x)
+        assert out.shape == x.shape
+        assert float(aux) > 0
+        # expert params have leading E axis (shardable over 'expert')
+        assert params["experts"]["fc1_kernel"].shape[0] == 4
+
+    def test_top1_routes_all_tokens_under_capacity(self):
+        moe = MoEMlp(num_experts=2, top_k=1, capacity_factor=2.0,
+                     dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(1, 8, 4)),
+                        jnp.float32)
+        params = moe.init(jax.random.key(0), x)["params"]
+        out, _ = moe.apply({"params": params}, x)
+        # with ample capacity no token output is exactly zero
+        assert (np.abs(np.asarray(out)).sum(-1) > 0).all()
+
+    def test_gradients_flow_to_experts_and_router(self):
+        moe = MoEMlp(num_experts=2, top_k=1, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 8, 4)),
+                        jnp.float32)
+        params = moe.init(jax.random.key(0), x)["params"]
+
+        def loss(p):
+            out, aux = moe.apply({"params": p}, x)
+            return jnp.sum(out ** 2) + aux
+        g = jax.grad(loss)(params)
+        for path in (("experts", "fc1_kernel"), ("router", "kernel")):
+            leaf = g
+            for k in path:
+                leaf = leaf[k]
+            assert float(jnp.abs(leaf).sum()) > 0, path
+
+    def test_moe_shards_on_expert_mesh(self):
+        from deeplearning_tpu.parallel import MeshConfig, build_mesh
+        from deeplearning_tpu.parallel.sharding import shard_params_tree
+        mesh = build_mesh(MeshConfig(data=-1, expert=4))
+        moe = MoEMlp(num_experts=4, dtype=jnp.float32)
+        x = jnp.zeros((2, 16, 8))
+        params = moe.init(jax.random.key(0), x)["params"]
+        sh = shard_params_tree(params, mesh, MOE_RULES)
+        from jax.sharding import PartitionSpec as P
+        assert sh["experts"]["fc1_kernel"].spec == P("expert", None, None)
+        sharded = jax.device_put(params, sh)
+        out, aux = jax.jit(
+            lambda p, x: moe.apply({"params": p}, x))(sharded, x)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestExport:
+    def test_custom_call_my_add(self):
+        from deeplearning_tpu.export.custom_call import my_add, register
+        if not register():
+            pytest.skip("no host compiler")
+        a = jnp.asarray([1.0, 2.0])
+        b = jnp.asarray([5.0, 5.0])
+        out = jax.jit(my_add)(a, b)
+        np.testing.assert_allclose(np.asarray(out), [13.0, 16.0])
+
+    def test_stablehlo_roundtrip_model(self):
+        from deeplearning_tpu.export.serialize import (export_stablehlo,
+                                                       load_stablehlo)
+        model = MODELS.build("mnist_fcn", num_classes=3, dtype=jnp.float32)
+        x = jnp.zeros((1, 16, 16, 1))
+        params = model.init(jax.random.key(0), x)["params"]
+
+        def fn(img):
+            return model.apply({"params": params}, img)
+        blob = export_stablehlo(fn, [x])
+        restored = load_stablehlo(blob)
+        np.testing.assert_allclose(np.asarray(restored(x)),
+                                   np.asarray(fn(x)), atol=1e-6)
+
+    def test_flops_estimate_positive(self):
+        from deeplearning_tpu.export.serialize import flops_estimate
+        f = lambda x: x @ jnp.ones((8, 4))
+        assert flops_estimate(f, jnp.ones((2, 8))) > 0
+
+    def test_savedmodel_export(self, tmp_path):
+        from deeplearning_tpu.export.serialize import export_savedmodel
+        f = lambda x: jnp.tanh(x) * 2.0
+        ok = export_savedmodel(f, [jnp.ones((2, 3))],
+                               str(tmp_path / "sm"))
+        if not ok:
+            pytest.skip("tensorflow unavailable")
+        import tensorflow as tf
+        loaded = tf.saved_model.load(str(tmp_path / "sm"))
+        out = loaded.f(tf.ones((2, 3)))
+        np.testing.assert_allclose(out.numpy(), np.tanh(np.ones((2, 3))) * 2,
+                                   atol=1e-6)

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Unified training CLI — the successor of every per-project train.py.
+
+Usage:
+  python tools/train.py --cfg configs/vit_b16.yaml [key value ...]
+  python tools/train.py model.name=resnet50 data.synthetic=true train.epochs=2
+
+One entry point drives the whole zoo through the registry + Trainer
+(SURVEY.md §1.1: archetypes A/B/C collapse into config + hooks). Data
+comes from npz/folder sources or the built-in synthetic generator (for
+smoke tests; the reference bundles tiny datasets for the same purpose).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+# Platform override (e.g. DLTPU_PLATFORM=cpu for smoke tests). Needed
+# because this image's sitecustomize imports jax before any user code, so
+# the JAX_PLATFORMS env var is already consumed.
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCfg:
+    name: str = "mnist_cnn"
+    num_classes: int = 10
+    precision: str = "bf16"          # bf16 | f32
+
+
+@dataclasses.dataclass(frozen=True)
+class DataCfg:
+    npz: Optional[str] = None        # npz with images/labels arrays
+    synthetic: bool = True
+    image_size: int = 28
+    channels: int = 1
+    n_train: int = 512
+    global_batch: int = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimCfg:
+    name: str = "sgd"
+    lr: float = 0.05
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    schedule: str = "warmup_cosine"
+    warmup_steps: int = 10
+    clip_grad_norm: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainCfg:
+    epochs: int = 3
+    seed: int = 0
+    label_smoothing: float = 0.0
+    ema: bool = False
+    workdir: Optional[str] = None
+    mesh_model_axis: int = 1         # >1 enables tensor parallelism
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelCfg = dataclasses.field(default_factory=ModelCfg)
+    data: DataCfg = dataclasses.field(default_factory=DataCfg)
+    optim: OptimCfg = dataclasses.field(default_factory=OptimCfg)
+    train: TrainCfg = dataclasses.field(default_factory=TrainCfg)
+
+
+def load_data(cfg: DataCfg, num_classes: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    if cfg.npz:
+        blob = np.load(cfg.npz)
+        return blob["images"], blob["labels"]
+    rng = np.random.default_rng(0)
+    n, s, c = cfg.n_train, cfg.image_size, cfg.channels
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    images = rng.normal(0, 0.1, (n, s, s, c)).astype(np.float32)
+    block = max(s // num_classes, 1)
+    for i, lab in enumerate(labels):
+        images[i, :, lab * block:(lab + 1) * block, 0] += 2.0
+    return images, labels
+
+
+def main(argv=None) -> int:
+    from deeplearning_tpu.core.config import config_cli
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.data import ArraySource, DataLoader
+    from deeplearning_tpu.parallel import MeshConfig, build_mesh
+    from deeplearning_tpu.train import (TrainState, make_eval_step,
+                                        make_train_step, shard_state)
+    from deeplearning_tpu.train.classification import (make_loss_fn,
+                                                       make_metric_fn)
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+    from deeplearning_tpu.train.trainer import Trainer
+
+    cfg = config_cli(Config(), argv, description=__doc__)
+    images, labels = load_data(cfg.data, cfg.model.num_classes)
+    dtype = jnp.bfloat16 if cfg.model.precision == "bf16" else jnp.float32
+    model = MODELS.build(cfg.model.name, num_classes=cfg.model.num_classes,
+                         dtype=dtype)
+    sample = jnp.zeros((1,) + images.shape[1:])
+    variables = model.init(jax.random.key(cfg.train.seed), sample,
+                           train=False)
+    params = variables["params"]
+    steps_per_epoch = len(images) // cfg.data.global_batch
+    sched = build_schedule(cfg.optim.schedule, base_lr=cfg.optim.lr,
+                           total_steps=cfg.train.epochs * steps_per_epoch,
+                           warmup_steps=cfg.optim.warmup_steps)
+    tx = build_optimizer(cfg.optim.name, sched,
+                         clip_grad_norm=cfg.optim.clip_grad_norm or None,
+                         weight_decay=cfg.optim.weight_decay,
+                         momentum=cfg.optim.momentum, params=params)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx,
+        batch_stats=variables.get("batch_stats", {}),
+        use_ema=cfg.train.ema)
+
+    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis))
+    state = shard_state(state, mesh)
+    has_bn = bool(variables.get("batch_stats"))
+    loader = DataLoader(ArraySource(image=images, label=labels),
+                        global_batch=cfg.data.global_batch, mesh=mesh,
+                        seed=cfg.train.seed)
+    eval_loader = DataLoader(ArraySource(image=images, label=labels),
+                             global_batch=cfg.data.global_batch,
+                             mesh=mesh, shuffle=False)
+    trainer = Trainer(
+        state=state,
+        train_step=make_train_step(
+            make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh),
+        train_loader=loader,
+        eval_step=make_eval_step(make_metric_fn()),
+        eval_loader=eval_loader,
+        epochs=cfg.train.epochs,
+        seed=cfg.train.seed,
+        workdir=cfg.train.workdir,
+        log_every=max(steps_per_epoch // 2, 1))
+    trainer.train()
+    results = trainer.evaluate()
+    print({k: round(v, 4) for k, v in results.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
