@@ -1,2 +1,2 @@
-from . import config, logging, precision, registry, rng  # noqa: F401
+from . import config, experiment, logging, precision, registry, rng  # noqa: F401
 from .registry import MODELS, DATASETS, LOSSES, OPTIMIZERS, SCHEDULES  # noqa: F401

@@ -1,0 +1,132 @@
+"""Config-as-code experiments — the YOLOX Exp system, TPU-native.
+
+Surface of detection/YOLOX/yolox/exp/base_exp.py:17 (abstract BaseExp with
+get_model / get_data_loader / get_optimizer / get_lr_scheduler /
+get_evaluator factories; concrete yolox_base.py:16; exps/default/*.py
+subclass-per-variant; merge() for CLI opts). An Exp is a plain Python
+class whose attributes are the config and whose methods build the pieces;
+``get_exp`` loads one from a file path or registry name — the pattern the
+reference uses so experiments are versioned as code.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Any, Dict, Optional, Sequence
+
+from .registry import MODELS, Registry
+
+EXPERIMENTS = Registry("experiments")
+
+
+class BaseExp:
+    """Subclass, set attributes, override factories as needed."""
+    # mirrored attribute surface of yolox_base.Exp
+    model_name: str = "mnist_cnn"
+    num_classes: int = 10
+    precision: str = "bf16"
+    global_batch: int = 64
+    max_epochs: int = 3
+    base_lr: float = 0.05
+    warmup_steps: int = 10
+    optimizer: str = "sgd"
+    weight_decay: float = 0.0
+    scheduler: str = "warmup_cosine"
+    label_smoothing: float = 0.0
+    ema: bool = False
+    seed: int = 0
+
+    def merge(self, opts: Sequence[str]) -> "BaseExp":
+        """Apply ['key', 'value', ...] or ['key=value'] CLI overrides
+        (base_exp.py merge surface)."""
+        import yaml
+        i = 0
+        opts = list(opts)
+        pairs = []
+        while i < len(opts):
+            if "=" in opts[i]:
+                k, v = opts[i].split("=", 1)
+                pairs.append((k, v))
+                i += 1
+            else:
+                if i + 1 >= len(opts):
+                    raise ValueError(
+                        f"missing value for option {opts[i]!r}")
+                pairs.append((opts[i], opts[i + 1]))
+                i += 2
+        for k, v in pairs:
+            if not hasattr(self, k):
+                raise KeyError(f"Exp has no attribute {k!r}")
+            cur = getattr(self, k)
+            val = yaml.safe_load(v)
+            if cur is not None and not isinstance(val, type(cur)):
+                if isinstance(cur, float) and isinstance(val, int):
+                    val = float(val)
+                elif isinstance(cur, str):
+                    val = str(val)
+                else:
+                    raise ValueError(
+                        f"cannot assign {val!r} to {k} "
+                        f"(expected {type(cur).__name__})")
+            setattr(self, k, val)
+        return self
+
+    # ---- factories (override per experiment) ----
+    def get_model(self, **kw):
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+        return MODELS.build(self.model_name, num_classes=self.num_classes,
+                            dtype=dtype, **kw)
+
+    def get_lr_schedule(self, total_steps: int):
+        from ..train.schedules import build_schedule
+        return build_schedule(self.scheduler, base_lr=self.base_lr,
+                              total_steps=total_steps,
+                              warmup_steps=self.warmup_steps)
+
+    def get_optimizer(self, schedule, params):
+        from ..train.optim import build_optimizer
+        return build_optimizer(self.optimizer, schedule,
+                               weight_decay=self.weight_decay,
+                               params=params)
+
+    def get_loss_fn(self):
+        from ..train.classification import make_loss_fn
+        return make_loss_fn(self.label_smoothing)
+
+    def get_eval_fn(self):
+        from ..train.classification import make_metric_fn
+        return make_metric_fn()
+
+
+def get_exp(exp_file: Optional[str] = None, exp_name: Optional[str] = None
+            ) -> BaseExp:
+    """Load an Exp from a python file (must define ``Exp``) or from the
+    EXPERIMENTS registry (yolox/exp/build.py get_exp surface)."""
+    if exp_file:
+        spec = importlib.util.spec_from_file_location(
+            os.path.basename(exp_file).removesuffix(".py"), exp_file)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module.Exp()
+    if exp_name:
+        return EXPERIMENTS.build(exp_name)
+    raise ValueError("provide exp_file or exp_name")
+
+
+@EXPERIMENTS.register("mnist_smoke")
+class MnistSmokeExp(BaseExp):
+    pass
+
+
+@EXPERIMENTS.register("vit_b16")
+class ViTB16Exp(BaseExp):
+    model_name = "vit_base_patch16_224"
+    num_classes = 1000
+    global_batch = 128
+    base_lr = 1e-3
+    optimizer = "adamw"
+    weight_decay = 0.05
+    label_smoothing = 0.1
+    ema = True

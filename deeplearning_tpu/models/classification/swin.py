@@ -136,6 +136,8 @@ class SwinBlock(nn.Module):
     v2: bool = False
     dtype: Any = jnp.bfloat16
     use_pallas: bool = False
+    moe: bool = False                 # MoE MLP (swin_transformer_moe)
+    num_experts: int = 8
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -169,8 +171,17 @@ class SwinBlock(nn.Module):
         y = x
         if not self.v2:
             y = nn.LayerNorm(dtype=self.dtype, name="norm2")(y)
-        y = Mlp(self.mlp_ratio, self.drop, self.dtype, name="mlp")(
-            y, deterministic)
+        if self.moe:
+            from ...parallel.moe import MoEMlp
+            y, aux = MoEMlp(self.num_experts,
+                            hidden_ratio=self.mlp_ratio,
+                            drop=self.drop,
+                            dtype=self.dtype, name="moe_mlp")(
+                y, deterministic)
+            self.sow("losses", "moe_aux", aux)
+        else:
+            y = Mlp(self.mlp_ratio, self.drop, self.dtype, name="mlp")(
+                y, deterministic)
         if self.v2:
             y = nn.LayerNorm(dtype=self.dtype, name="norm2")(y)
         return x + DropPath(self.drop_path_rate)(y, deterministic)
@@ -216,6 +227,8 @@ class SwinTransformer(nn.Module):
     dtype: Any = jnp.bfloat16
     remat: bool = False
     use_pallas: bool = False
+    moe: bool = False                 # MoE MLP in every 2nd block
+    num_experts: int = 8
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -245,6 +258,7 @@ class SwinTransformer(nn.Module):
                         self.mlp_ratio, self.qkv_bias, self.drop_rate,
                         float(dpr[block_idx]), self.v2, self.dtype,
                         self.use_pallas,
+                        self.moe and i % 2 == 1, self.num_experts,
                         name=f"stage{stage}_block{i}")(x, deterministic)
                 block_idx += 1
             if stage < len(self.depths) - 1:
@@ -286,3 +300,8 @@ swinv2_tiny_patch4_window7_224 = _factory(
 swinv2_base_patch4_window7_224 = _factory(
     "swinv2_base_patch4_window7_224", embed_dim=128, depths=(2, 2, 18, 2),
     num_heads=(4, 8, 16, 32), v2=True)
+# MoE variant (swin_transformer_moe.py surface): MoE MLP in alternating
+# blocks; aux losses are sow'n under the "losses" collection
+swin_moe_tiny_patch4_window7_224 = _factory(
+    "swin_moe_tiny_patch4_window7_224", embed_dim=96, depths=(2, 2, 6, 2),
+    num_heads=(3, 6, 12, 24), moe=True)

@@ -78,6 +78,7 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.25
     hidden_ratio: float = 4.0
     aux_weight: float = 0.01
+    drop: float = 0.0
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -122,4 +123,5 @@ class MoEMlp(nn.Module):
                                self.dtype, name="experts")(expert_in)
         out = jnp.einsum("tec,eco->to", combine.astype(expert_out.dtype),
                          expert_out)
+        out = nn.Dropout(self.drop, deterministic=deterministic)(out)
         return out.reshape(b, n, d), self.aux_weight * aux

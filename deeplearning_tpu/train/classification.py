@@ -29,12 +29,14 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False,
         variables = state.variables(params)
         kwargs = dict(train=True, rngs={"dropout": rng})
         aux: Dict[str, Any] = {}
+        # "losses" collects model-internal auxiliary losses (e.g. MoE
+        # load-balance, sown by MoEMlp) — always harvested into the loss
+        logits, mutated = state.apply_fn(
+            variables, batch["image"], mutable=["batch_stats", "losses"],
+            **kwargs)
         if has_batch_stats:
-            logits, mutated = state.apply_fn(
-                variables, batch["image"], mutable=["batch_stats"], **kwargs)
             aux["batch_stats"] = mutated["batch_stats"]
-        else:
-            logits = state.apply_fn(variables, batch["image"], **kwargs)
+        model_aux_losses = jax.tree.leaves(mutated.get("losses", {}))
         aux_logits = ()
         if isinstance(logits, tuple):
             logits, aux_logits = logits
@@ -49,6 +51,8 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False,
             if a is not None and labels.ndim < logits.ndim + 1:
                 loss = loss + aux_weight * losses.cross_entropy(
                     a, acc_labels, label_smoothing)
+        for al in model_aux_losses:
+            loss = loss + al
         acc = jnp.mean((jnp.argmax(logits, -1) == acc_labels).astype(
             jnp.float32))
         aux["metrics"] = {"accuracy": acc}

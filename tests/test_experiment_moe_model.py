@@ -1,0 +1,82 @@
+"""Exp config-as-code system + Swin-MoE model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.experiment import (EXPERIMENTS, BaseExp,
+                                              get_exp)
+from deeplearning_tpu.core.registry import MODELS
+
+
+class TestExpSystem:
+    def test_registry_and_merge(self):
+        exp = get_exp(exp_name="mnist_smoke")
+        exp.merge(["base_lr", "0.2", "max_epochs=5"])
+        assert exp.base_lr == 0.2 and exp.max_epochs == 5
+        with pytest.raises(KeyError):
+            exp.merge(["nonexistent", "1"])
+
+    def test_factories_build(self):
+        exp = get_exp(exp_name="mnist_smoke")
+        model = exp.get_model()
+        assert type(model).__name__ == "MnistCNN"
+        sched = exp.get_lr_schedule(100)
+        assert float(sched(0)) >= 0
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, 28, 28, 1)))["params"]
+        tx = exp.get_optimizer(sched, params)
+        tx.init(params)
+
+    def test_exp_from_file(self, tmp_path):
+        p = tmp_path / "my_exp.py"
+        p.write_text(
+            "from deeplearning_tpu.core.experiment import BaseExp\n"
+            "class Exp(BaseExp):\n"
+            "    model_name = 'resnet18'\n"
+            "    base_lr = 0.3\n")
+        exp = get_exp(exp_file=str(p))
+        assert exp.model_name == "resnet18" and exp.base_lr == 0.3
+
+
+class TestSwinMoE:
+    def test_forward_with_aux_losses(self):
+        model = MODELS.build("swin_moe_tiny_patch4_window7_224",
+                             num_classes=4, patch_size=2, embed_dim=32,
+                             depths=(2, 2), num_heads=(2, 4),
+                             num_experts=2, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 56, 56, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out, aux = model.apply(variables, x, train=False,
+                               mutable=["losses"])
+        assert out.shape == (2, 4)
+        auxes = jax.tree.leaves(aux["losses"])
+        assert len(auxes) >= 2             # one per MoE block
+        assert all(float(a) >= 0 for a in auxes)
+        # expert params exist with leading E axis
+        flat = jax.tree_util.tree_flatten_with_path(
+            variables["params"])[0]
+        moe_kernels = [l for kp, l in flat
+                       if any("moe_mlp" in str(k) for k in kp)
+                       and l.ndim == 3]
+        assert moe_kernels and all(k.shape[0] == 2 for k in moe_kernels)
+
+    def test_trainable_with_aux_in_loss(self):
+        model = MODELS.build("swin_moe_tiny_patch4_window7_224",
+                             num_classes=4, patch_size=2, embed_dim=32,
+                             depths=(2, 2), num_heads=(2, 4),
+                             num_experts=2, dtype=jnp.float32)
+        x = jnp.zeros((2, 56, 56, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+
+        def loss(p):
+            logits, aux = model.apply({"params": p}, x, train=False,
+                                      mutable=["losses"])
+            ce = -jax.nn.log_softmax(logits)[:, 0].mean()
+            return ce + sum(jax.tree.leaves(aux["losses"]))
+        g = jax.grad(loss)(variables["params"])
+        leaves = [np.asarray(v, np.float64) for v in jax.tree.leaves(g)]
+        assert all(np.isfinite(l).all() for l in leaves)
+        assert max(np.abs(l).max() for l in leaves) > 0
