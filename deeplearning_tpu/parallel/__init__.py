@@ -1,4 +1,4 @@
-from . import moe  # noqa: F401
+from . import moe, pipeline  # noqa: F401
 from .mesh import (MeshConfig, build_mesh, data_parallel_mesh,  # noqa: F401
                    initialize_distributed, DATA_AXIS, FSDP_AXIS, SEQ_AXIS,
                    MODEL_AXIS, EXPERT_AXIS)

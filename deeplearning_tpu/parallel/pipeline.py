@@ -1,0 +1,94 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+A capability beyond the reference (SURVEY.md §2.9: PP absent). Design:
+stage parameters carry a leading S (stage) axis sharded over the ``pipe``
+mesh axis; the schedule runs inside shard_map — each device applies its
+stage to its current microbatch then ppermutes activations to the next
+device. With M microbatches and S stages the loop runs S+M-1 ticks
+(bubble fraction (S-1)/(S+M-1)), all under one jit.
+
+The stage function must be shape-preserving (same activation shape in and
+out, the usual transformer-block setting), which keeps the rotating
+buffer static-shaped.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "model"     # reuse the model axis for stages by default
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,           # pytree with leading S axis on leaves
+    x: jax.Array,                # (M, micro_batch, ...) microbatches
+    mesh: Mesh,
+    axis_name: str = PIPE_AXIS,
+) -> jax.Array:
+    """Run x through S pipelined stages; returns (M, micro_batch, ...).
+
+    stage_fn(params_slice, activation) -> activation, applied by every
+    device to the microbatch currently resident on it.
+    """
+    s = mesh.shape[axis_name]
+    m = x.shape[0]
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(param_specs, P(axis_name)),
+        out_specs=P(axis_name))
+    def run(params, xs):
+        # params: leaves (1, ...) — this device's stage; xs (ceil(M/S), ...)
+        # microbatches are sharded over the axis for storage; gather to a
+        # local queue (M is small; activations are microbatch-sized)
+        params = jax.tree.map(lambda p: p[0], params)
+        all_x = jax.lax.all_gather(xs, axis_name, tiled=True)  # (M, ...)
+        idx = jax.lax.axis_index(axis_name)
+        n_ticks = s + m - 1
+        perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def tick(t, carry):
+            buf, outputs = carry
+            # stage 0 ingests microbatch t (if any) — other stages use buf
+            feed = jnp.where(t < m, t, 0)
+            incoming = jnp.where(idx == 0, 1.0, 0.0)
+            inject = all_x[feed] * incoming + buf * (1 - incoming)
+            y = stage_fn(params, inject)
+            # device s-1's output at tick t is microbatch t-(s-1)
+            out_slot = t - (s - 1)
+            is_last = idx == s - 1
+            valid = (out_slot >= 0) & (out_slot < m) & is_last
+            outputs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_slot, 0), 0),
+                lambda o: o, outputs)
+            # rotate activations forward one stage
+            buf = jax.lax.ppermute(y, axis_name, perm)
+            return buf, outputs
+
+        buf0 = jnp.zeros_like(all_x[0])
+        outputs0 = jnp.zeros_like(all_x)
+        _, outputs = jax.lax.fori_loop(0, n_ticks, tick, (buf0, outputs0))
+        # outputs live on the last stage; share them back to all devices
+        outputs = jax.lax.psum(outputs, axis_name)
+        # return this device's storage shard
+        per_dev = m // s
+        return jax.lax.dynamic_slice_in_dim(outputs, idx * per_dev,
+                                            per_dev, 0)
+
+    return run(stage_params, x)
+
+
+def stack_stage_params(params_list) -> Any:
+    """[stage0_params, stage1_params, ...] (same structure) → stacked
+    pytree with leading S axis, ready for P('model') sharding."""
+    return jax.tree.map(lambda *ps: jnp.stack(ps), *params_list)

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Export CLI — the per-project export.py successor (yolov5 export.py
+surface: one flag per backend).
+
+  python tools/export.py --model vit_base_patch16_224 --num-classes 1000 \\
+      --size 224 --format stablehlo --out model.shlo
+  python tools/export.py --model resnet50 --format savedmodel --out sm/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--channels", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--format", choices=("stablehlo", "savedmodel"),
+                    default="stablehlo")
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.core.checkpoint import load_pytree
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.export.serialize import (export_savedmodel,
+                                                   export_stablehlo,
+                                                   flops_estimate)
+
+    model = MODELS.build(args.model, num_classes=args.num_classes)
+    example = jnp.zeros((args.batch, args.size, args.size, args.channels))
+    variables = model.init(jax.random.key(0), example, train=False)
+    if args.ckpt:
+        restored = load_pytree(args.ckpt)
+        params = restored.get("params", restored) \
+            if isinstance(restored, dict) else restored
+        variables = {**variables, "params": params}
+
+    def fn(x):
+        return model.apply(variables, x, train=False)
+
+    print(f"model FLOPs (fwd, batch {args.batch}): "
+          f"{flops_estimate(fn, example) / 1e9:.2f} G")
+    if args.format == "stablehlo":
+        blob = export_stablehlo(fn, [example], args.out)
+        print(f"wrote {len(blob)} bytes of StableHLO to {args.out}")
+    else:
+        ok = export_savedmodel(fn, [example], args.out)
+        print(f"SavedModel written to {args.out}" if ok
+              else "tensorflow unavailable")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Inference CLI — the per-project predict.py successor.
+
+  python tools/predict.py --model mnist_cnn --ckpt runs/x/ckpt/best \\
+      --input img.png [--classes class_indices.json] [--topk 5]
+
+Loads a checkpointed TrainState's params, runs one image (or an .npz
+batch) through the model, prints top-k classes (swin predict.py:31-130
+surface). Detection models print fixed-shape box outputs instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def load_image(path: str, size: int) -> np.ndarray:
+    from deeplearning_tpu.data.transforms import (classification_eval_transform)
+    if path.endswith(".npz"):
+        return np.load(path)["images"]
+    if path.endswith(".npy"):
+        img = np.load(path)
+    else:
+        from PIL import Image
+        img = np.asarray(Image.open(path).convert("RGB"), np.float32)
+    fn = classification_eval_transform((size, size))
+    return fn({"image": img[None]})["image"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--ckpt", default=None,
+                    help="orbax checkpoint dir (step dir or 'best')")
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--topk", type=int, default=5)
+    ap.add_argument("--classes", default=None,
+                    help="json mapping class index -> name")
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.core.checkpoint import load_pytree
+    from deeplearning_tpu.core.registry import MODELS
+
+    model = MODELS.build(args.model, num_classes=args.num_classes)
+    images = jnp.asarray(load_image(args.input, args.size))
+    variables = model.init(jax.random.key(0), images[:1], train=False)
+    if args.ckpt:
+        restored = load_pytree(args.ckpt)
+        # accept either a bare param tree or a full TrainState dict
+        params = restored.get("params", restored) \
+            if isinstance(restored, dict) else restored
+        variables = {**variables, "params": params}
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, images)
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    names = {}
+    if args.classes:
+        with open(args.classes) as f:
+            names = {int(k): v for k, v in json.load(f).items()}
+    for bi, p in enumerate(probs):
+        order = np.argsort(-p)[: args.topk]
+        print(f"image {bi}: " + "  ".join(
+            f"{names.get(int(i), int(i))}={p[i]:.4f}" for i in order))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
