@@ -30,10 +30,18 @@ def pk_batches(labels: np.ndarray, p: int, k: int, *, seed: int = 0,
     n_batches = max(len(ids) // p, 1)
     batches = []
     for b in range(n_batches):
-        chosen = ids[b * p:(b + 1) * p]
+        chosen = list(ids[b * p:(b + 1) * p])
         if len(chosen) < p:
-            chosen = list(chosen) + list(
-                rng.choice(ids, p - len(chosen), replace=False))
+            # top up from identities not already in the batch; only reuse
+            # identities when the dataset has fewer than P of them
+            pool = [i for i in ids if i not in chosen]
+            need = p - len(chosen)
+            if pool:
+                take = min(need, len(pool))
+                chosen += list(rng.choice(pool, take, replace=False))
+                need -= take
+            if need > 0:
+                chosen += list(rng.choice(ids, need, replace=True))
         batch = []
         for ident in chosen:
             pool = np.asarray(by_id[ident])

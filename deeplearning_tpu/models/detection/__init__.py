@@ -1,1 +1,2 @@
-from . import faster_rcnn, fcos, fpn, retinanet, yolov5, yolox  # noqa: F401
+from . import (faster_rcnn, fcos, fpn, retinanet, yolo_builder,  # noqa: F401
+               yolov5, yolox)

@@ -1,0 +1,146 @@
+"""Spec-driven YOLO model assembly — the parse_model YAML builder.
+
+Surface of detection/yolov5/models/yolo.py:121/:297: the model is a list
+of layer specs ``[from, number, module, args]`` evaluated top to bottom,
+where ``from`` indexes previously produced tensors (-1 = previous, lists
+= concat inputs) — the mechanism behind yolov5s.yaml etc. Vocabulary:
+Conv, C3 (CSP), SPP, Focus, Upsample, Concat, Detect. Specs can come
+from a YAML file with the same structure as the reference's model yamls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import yaml
+
+from ...core.registry import MODELS
+from .yolox import ConvBnSiLU, CSPLayer, SPPBottleneck
+
+Spec = Tuple[Union[int, List[int]], int, str, list]
+
+
+class SpecModel(nn.Module):
+    """Evaluate a layer-spec list (parse_model semantics)."""
+    spec: Sequence[Spec]
+    num_classes: int = 80
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+    anchors_per_loc: int = 3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def w(c):
+            return max(int(c * self.width_mult), 1)
+
+        def d(n):
+            return max(int(round(n * self.depth_mult)), 1)
+
+        outputs: List[jax.Array] = []
+        y = x.astype(self.dtype)
+        detect_outs: List[jax.Array] = []
+        for li, (frm, num, mod, args) in enumerate(self.spec):
+            # flax freezes module attrs: lists arrive as tuples
+            frm_list = list(frm) if isinstance(frm, (list, tuple)) else [frm]
+            inputs = [outputs[f] if f != -1 else y for f in frm_list]
+            inp = inputs[0] if len(inputs) == 1 else None
+            name = f"l{li}_{mod.lower()}"
+            if mod == "Focus":
+                p = jnp.concatenate([
+                    inp[:, 0::2, 0::2], inp[:, 1::2, 0::2],
+                    inp[:, 0::2, 1::2], inp[:, 1::2, 1::2]], axis=-1)
+                y = ConvBnSiLU(w(args[0]), args[1] if len(args) > 1 else 3,
+                               dtype=self.dtype, name=name)(p, train)
+            elif mod == "Conv":
+                ch, k = args[0], args[1] if len(args) > 1 else 1
+                s = args[2] if len(args) > 2 else 1
+                y = ConvBnSiLU(w(ch), k, s, dtype=self.dtype,
+                               name=name)(inp, train)
+            elif mod == "C3":
+                shortcut = args[1] if len(args) > 1 else True
+                y = CSPLayer(w(args[0]), d(num), shortcut,
+                             dtype=self.dtype, name=name)(inp, train)
+            elif mod == "SPP":
+                y = SPPBottleneck(w(args[0]), self.dtype,
+                                  name=name)(inp, train)
+            elif mod == "Upsample":
+                b, h, wd, c = inp.shape
+                y = jax.image.resize(inp, (b, h * 2, wd * 2, c), "nearest")
+            elif mod == "Concat":
+                y = jnp.concatenate(inputs, axis=-1)
+            elif mod == "Detect":
+                for di, feat in enumerate(inputs):
+                    p = nn.Conv(self.anchors_per_loc
+                                * (5 + self.num_classes), (1, 1),
+                                dtype=self.dtype,
+                                name=f"{name}_{di}")(feat)
+                    b = p.shape[0]
+                    detect_outs.append(p.reshape(
+                        b, -1, 5 + self.num_classes))
+                # Detect produces no feature map; keep a valid tensor in
+                # the outputs slot so later `from` references fail loudly
+                # in shape rather than on None
+                y = inputs[0]
+            else:
+                raise ValueError(f"unknown module {mod!r} in spec")
+            outputs.append(y)
+        if detect_outs:
+            return jnp.concatenate(detect_outs, 1).astype(jnp.float32)
+        return y.astype(jnp.float32)
+
+
+# yolov5-v5.0 layout as a spec list (the yolov5s.yaml content)
+YOLOV5_SPEC: Sequence[Spec] = (
+    (-1, 1, "Focus", [64]),          # 0
+    (-1, 1, "Conv", [128, 3, 2]),    # 1
+    (-1, 3, "C3", [128]),            # 2
+    (-1, 1, "Conv", [256, 3, 2]),    # 3
+    (-1, 9, "C3", [256]),            # 4  (P3)
+    (-1, 1, "Conv", [512, 3, 2]),    # 5
+    (-1, 9, "C3", [512]),            # 6  (P4)
+    (-1, 1, "Conv", [1024, 3, 2]),   # 7
+    (-1, 1, "SPP", [1024]),          # 8
+    (-1, 3, "C3", [1024, False]),    # 9  (P5)
+    (-1, 1, "Conv", [512, 1]),       # 10
+    (-1, 1, "Upsample", []),         # 11
+    ([-1, 6], 1, "Concat", []),      # 12
+    (-1, 3, "C3", [512, False]),     # 13
+    (-1, 1, "Conv", [256, 1]),       # 14
+    (-1, 1, "Upsample", []),         # 15
+    ([-1, 4], 1, "Concat", []),      # 16
+    (-1, 3, "C3", [256, False]),     # 17 (out P3)
+    (-1, 1, "Conv", [256, 3, 2]),    # 18
+    ([-1, 14], 1, "Concat", []),     # 19
+    (-1, 3, "C3", [512, False]),     # 20 (out P4)
+    (-1, 1, "Conv", [512, 3, 2]),    # 21
+    ([-1, 10], 1, "Concat", []),     # 22
+    (-1, 3, "C3", [1024, False]),    # 23 (out P5)
+    ([17, 20, 23], 1, "Detect", []),  # 24
+)
+
+
+def load_spec_yaml(path: str) -> Dict[str, Any]:
+    """Load a reference-style model yaml: {depth_multiple, width_multiple,
+    backbone: [...], head: [...]} → kwargs for SpecModel."""
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    spec = [tuple(row) for row in
+            list(doc.get("backbone", [])) + list(doc.get("head", []))]
+    return {
+        "spec": spec,
+        "depth_mult": float(doc.get("depth_multiple", 1.0)),
+        "width_mult": float(doc.get("width_multiple", 1.0)),
+        "num_classes": int(doc.get("nc", 80)),
+    }
+
+
+@MODELS.register("yolov5_from_spec")
+def yolov5_from_spec(num_classes: int = 80, spec=YOLOV5_SPEC,
+                     **kw):
+    defaults = dict(depth_mult=0.33, width_mult=0.5)
+    return SpecModel(spec=tuple(spec), num_classes=num_classes,
+                     **{**defaults, **kw})

@@ -195,6 +195,16 @@ def arcface_logits(embeddings: jax.Array, weight: jax.Array,
     return s * (onehot * target_cos + (1 - onehot) * cos)
 
 
+def wnfc_logits(embeddings: jax.Array, weight: jax.Array,
+                s: float = 64.0) -> jax.Array:
+    """Weight-normalized FC logits (Happy-Whale arcFaceloss.py:58 wnfc):
+    cosine classifier without the angular margin — scaled cos(theta)."""
+    emb = embeddings / (jnp.linalg.norm(embeddings, axis=-1,
+                                        keepdims=True) + 1e-12)
+    w = weight / (jnp.linalg.norm(weight, axis=0, keepdims=True) + 1e-12)
+    return s * (emb @ w)
+
+
 def heatmap_mse_loss(pred: jax.Array, target: jax.Array,
                      visible: jax.Array) -> jax.Array:
     """Visibility-weighted keypoint-heatmap MSE (Insulator utils/loss.py:6).

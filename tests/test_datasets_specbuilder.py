@@ -1,0 +1,107 @@
+"""Folder dataset discovery + spec-driven YOLO builder + wnfc."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.data.datasets import (folder_source, read_split_data,
+                                            write_class_indices)
+from deeplearning_tpu.models.detection.yolo_builder import (SpecModel,
+                                                            YOLOV5_SPEC,
+                                                            load_spec_yaml)
+
+
+@pytest.fixture
+def image_root(tmp_path):
+    for c in ("ant", "bee", "cat"):
+        d = tmp_path / c
+        d.mkdir()
+        for i in range(4):
+            np.save(d / f"{i}.npy",
+                    np.full((8, 8, 3), hash(c) % 7 + i, np.float32))
+    return str(tmp_path)
+
+
+class TestFolderDataset:
+    def test_split_and_classes(self, image_root):
+        split = read_split_data(image_root, val_rate=0.25, seed=0)
+        assert split["class_to_idx"] == {"ant": 0, "bee": 1, "cat": 2}
+        assert len(split["train_paths"]) + len(split["val_paths"]) == 12
+        assert len(split["val_paths"]) == 3
+        # deterministic given seed
+        split2 = read_split_data(image_root, val_rate=0.25, seed=0)
+        assert split["val_paths"] == split2["val_paths"]
+
+    def test_folder_source_and_loader(self, image_root):
+        from deeplearning_tpu.data import DataLoader
+        split = read_split_data(image_root, val_rate=0.25, seed=0)
+        src = folder_source(split["train_paths"], split["train_labels"])
+        loader = DataLoader(src, global_batch=4, seed=0)
+        batch = next(iter(loader))
+        assert batch["image"].shape == (4, 8, 8, 3)
+        assert batch["label"].shape == (4,)
+
+    def test_class_indices_json(self, image_root, tmp_path):
+        split = read_split_data(image_root, val_rate=0.25)
+        p = str(tmp_path / "ci.json")
+        write_class_indices(split["class_to_idx"], p)
+        import json
+        with open(p) as f:
+            inv = json.load(f)
+        assert inv["0"] == "ant" and inv["2"] == "cat"
+
+
+class TestSpecBuilder:
+    def test_matches_grid_count(self):
+        from deeplearning_tpu.models.detection.yolov5 import yolov5_grid
+        m = MODELS.build("yolov5_from_spec", num_classes=2,
+                         width_mult=0.25, dtype=jnp.float32)
+        x = jnp.zeros((1, 64, 64, 3))
+        v = m.init(jax.random.key(0), x, train=False)
+        raw = m.apply(v, x, train=False)
+        grid = yolov5_grid((64, 64))
+        assert raw.shape == (1, len(grid["cell"]), 7)
+
+    def test_yaml_spec_loading(self, tmp_path):
+        yaml_text = """
+nc: 4
+depth_multiple: 0.33
+width_multiple: 0.25
+backbone:
+  - [-1, 1, Focus, [16]]
+  - [-1, 1, Conv, [32, 3, 2]]
+  - [-1, 1, C3, [32]]
+head:
+  - [[-1], 1, Detect, []]
+"""
+        p = tmp_path / "tiny.yaml"
+        p.write_text(yaml_text)
+        kwargs = load_spec_yaml(str(p))
+        assert kwargs["num_classes"] == 4
+        model = SpecModel(spec=tuple(map(tuple, kwargs["spec"])),
+                          num_classes=4, width_mult=kwargs["width_mult"],
+                          depth_mult=kwargs["depth_mult"],
+                          dtype=jnp.float32)
+        x = jnp.zeros((1, 32, 32, 3))
+        v = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (1, (32 // 4) ** 2 * 3, 9)
+
+    def test_unknown_module_raises(self):
+        model = SpecModel(spec=((-1, 1, "Bogus", []),), dtype=jnp.float32)
+        with pytest.raises(ValueError):
+            model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+
+
+class TestWnfc:
+    def test_cosine_classifier(self):
+        from deeplearning_tpu.ops.losses import wnfc_logits
+        emb = jnp.asarray([[1.0, 0.0]])
+        w = jnp.asarray([[1.0, 0.0], [0.0, 1.0]]).T
+        logits = wnfc_logits(emb, w, s=10.0)
+        np.testing.assert_allclose(np.asarray(logits), [[10.0, 0.0]],
+                                   atol=1e-5)

@@ -28,17 +28,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def load_image(path: str, size: int) -> np.ndarray:
-    from deeplearning_tpu.data.transforms import (classification_eval_transform)
+def load_batch(path: str, size: int) -> np.ndarray:
+    """Any input format goes through the same eval transform."""
+    from deeplearning_tpu.data.datasets import load_image
+    from deeplearning_tpu.data.transforms import (
+        classification_eval_transform)
     if path.endswith(".npz"):
-        return np.load(path)["images"]
-    if path.endswith(".npy"):
-        img = np.load(path)
+        imgs = np.load(path)["images"]
     else:
-        from PIL import Image
-        img = np.asarray(Image.open(path).convert("RGB"), np.float32)
+        imgs = load_image(path)[None]
     fn = classification_eval_transform((size, size))
-    return fn({"image": img[None]})["image"]
+    return fn({"image": imgs})["image"]
 
 
 def main(argv=None) -> int:
@@ -58,7 +58,7 @@ def main(argv=None) -> int:
     from deeplearning_tpu.core.registry import MODELS
 
     model = MODELS.build(args.model, num_classes=args.num_classes)
-    images = jnp.asarray(load_image(args.input, args.size))
+    images = jnp.asarray(load_batch(args.input, args.size))
     variables = model.init(jax.random.key(0), images[:1], train=False)
     if args.ckpt:
         restored = load_pytree(args.ckpt)
