@@ -58,8 +58,12 @@ class SpecModel(nn.Module):
             elif mod == "Conv":
                 ch, k = args[0], args[1] if len(args) > 1 else 1
                 s = args[2] if len(args) > 2 else 1
-                y = ConvBnSiLU(w(ch), k, s, dtype=self.dtype,
-                               name=name)(inp, train)
+                y = inp
+                for r in range(max(d(num), 1)):   # parse_model repeats
+                    y = ConvBnSiLU(w(ch), k, s if r == 0 else 1,
+                                   dtype=self.dtype,
+                                   name=f"{name}_{r}" if num > 1
+                                   else name)(y, train)
             elif mod == "C3":
                 shortcut = args[1] if len(args) > 1 else True
                 y = CSPLayer(w(args[0]), d(num), shortcut,
@@ -67,9 +71,17 @@ class SpecModel(nn.Module):
             elif mod == "SPP":
                 y = SPPBottleneck(w(args[0]), self.dtype,
                                   name=name)(inp, train)
-            elif mod == "Upsample":
+            elif mod in ("Upsample", "nn.Upsample"):
+                # reference yaml args: [size(None), scale_factor, mode]
+                scale = 2
+                method = "nearest"
+                if len(args) >= 2 and args[1]:
+                    scale = int(args[1])
+                if len(args) >= 3 and args[2]:
+                    method = str(args[2])
                 b, h, wd, c = inp.shape
-                y = jax.image.resize(inp, (b, h * 2, wd * 2, c), "nearest")
+                y = jax.image.resize(inp, (b, h * scale, wd * scale, c),
+                                     method)
             elif mod == "Concat":
                 y = jnp.concatenate(inputs, axis=-1)
             elif mod == "Detect":

@@ -29,14 +29,15 @@ import numpy as np
 
 
 def load_batch(path: str, size: int) -> np.ndarray:
-    """Any input format goes through the same eval transform."""
+    """Image files go through the eval transform; .npz batches are
+    MODEL-READY by convention (tools/train.py feeds npz arrays raw), so
+    they bypass normalization — mixing the two would double-normalize."""
     from deeplearning_tpu.data.datasets import load_image
     from deeplearning_tpu.data.transforms import (
         classification_eval_transform)
     if path.endswith(".npz"):
-        imgs = np.load(path)["images"]
-    else:
-        imgs = load_image(path)[None]
+        return np.load(path)["images"]
+    imgs = load_image(path)[None]
     fn = classification_eval_transform((size, size))
     return fn({"image": imgs})["image"]
 
