@@ -9,11 +9,28 @@ effects are included honestly.
 """
 
 import json
+import os
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Watchdog: the TPU tunnel in this image can wedge (hangs instead of
+# erroring). If the benchmark hasn't printed within the deadline, emit a
+# clearly-marked fallback line so the driver always records something.
+_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "900"))
+_DONE = threading.Event()
+
+
+def _watchdog():
+    if not _DONE.wait(_DEADLINE_S):
+        print(json.dumps({
+            "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
+            "vs_baseline": 0.0, "error": "timeout: device unreachable "
+            f"within {_DEADLINE_S}s (tunnel wedge)"}), flush=True)
+        os._exit(2)
 
 PEAK_BF16_FLOPS = {
     # per-chip dense bf16 peak; device_kind substring -> FLOP/s
@@ -35,6 +52,7 @@ def peak_flops(device) -> float:
 
 
 def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.train import TrainState, make_train_step
     from deeplearning_tpu.train.classification import make_loss_fn
@@ -92,6 +110,7 @@ def main():
         "device": jax.devices()[0].device_kind,
         "batch": batch,
     }))
+    _DONE.set()
 
 
 if __name__ == "__main__":

@@ -162,6 +162,103 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _fwd_kernel_hb(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                   sm_scale: float, block_k: int, kv_len: int,
+                   causal: bool, q_block: int):
+    """Head-batched forward: blocks carry HB heads so each program feeds
+    the MXU HB small matmuls in one batched dot_general — amortizes the
+    per-program overhead that dominates at short N / small head dim."""
+    qi = pl.program_id(1)
+    q = q_ref[...]                      # (HB, block_q, d)
+    n = k_ref.shape[1]
+    nk = n // block_k
+    hb, bq, d = q.shape
+
+    def body(ki, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[:, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[:, pl.ds(ki * block_k, block_k), :]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)   # (HB, bq, block_k)
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        mask = col < kv_len
+        if causal:
+            row = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        m_cur = jnp.max(s, axis=2)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=2)
+        acc = acc * alpha[..., None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc = jnp.zeros((hb, bq, d), jnp.float32)
+    m0 = jnp.full((hb, bq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hb, bq), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l_safe[..., None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l_safe)
+    lse_ref[...] = jnp.broadcast_to(lse[..., None],
+                                    lse.shape + (8,))
+
+
+def flash_attention_hb(q, k, v, *, sm_scale=None, causal=False,
+                       block_q: int = DEFAULT_BLOCK_Q,
+                       block_k: int = DEFAULT_BLOCK_K,
+                       head_block: int = 4):
+    """Forward-only head-batched flash attention (B, H, N, D). For
+    training use ``flash_attention`` (custom VJP); this variant targets
+    inference / short-N regimes where program overhead dominates."""
+    b, h, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    while h % head_block:
+        head_block //= 2
+    head_block = max(head_block, 1)
+    block_q = min(block_q, _round_block(n))
+    block_k = min(block_k, _round_block(n))
+    n_pad = -n % math.lcm(block_q, block_k)
+    if n_pad:
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    np_tot = n + n_pad
+    qf = q.reshape(b * h, np_tot, d)
+    kf = k.reshape(b * h, np_tot, d)
+    vf = v.reshape(b * h, np_tot, d)
+    hb = head_block
+    grid = (b * h // hb, np_tot // block_q)
+    kernel = functools.partial(_fwd_kernel_hb, sm_scale=sm_scale,
+                               block_k=block_k, kv_len=n, causal=causal,
+                               q_block=block_q)
+    out, _ = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((hb, block_q, d), lambda g, qi: (g, qi, 0)),
+            pl.BlockSpec((hb, np_tot, d), lambda g, qi: (g, 0, 0)),
+            pl.BlockSpec((hb, np_tot, d), lambda g, qi: (g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((hb, block_q, d), lambda g, qi: (g, qi, 0)),
+            pl.BlockSpec((hb, block_q, 8), lambda g, qi: (g, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, np_tot, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, np_tot, 8), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, np_tot, d)[:, :, :n, :]
+
+
 def _flatten_bh(x):
     b, h, n, d = x.shape
     return x.reshape(b * h, n, d)

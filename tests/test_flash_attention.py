@@ -117,3 +117,23 @@ class TestLayoutWrapper:
         np.testing.assert_allclose(np.asarray(out1),
                                    np.asarray(out2.transpose(0, 2, 1, 3)),
                                    atol=1e-6)
+
+
+class TestHeadBatchedForward:
+    def test_matches_reference(self):
+        from deeplearning_tpu.ops.pallas.flash_attention import (
+            flash_attention_hb)
+        q, k, v = rand_qkv(b=2, h=4, n=197, d=32)
+        out = flash_attention_hb(q, k, v, head_block=4)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_head_block_not_dividing_heads(self):
+        from deeplearning_tpu.ops.pallas.flash_attention import (
+            flash_attention_hb)
+        q, k, v = rand_qkv(b=1, h=3, n=64, d=32)   # 3 heads, hb falls to 1
+        out = flash_attention_hb(q, k, v, head_block=4)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
