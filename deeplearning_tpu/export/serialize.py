@@ -51,11 +51,16 @@ def export_savedmodel(fn: Callable, example_args: Sequence[Any],
         jax2tf.convert(fn, with_gradient=False),
         autograph=False,
         input_signature=[
-            tf.TensorSpec(np.shape(a), np.asarray(a).dtype)
-            for a in example_args])
+            tf.TensorSpec(np.shape(a), np.asarray(a).dtype, name=f"arg{i}")
+            for i, a in enumerate(example_args)])
     module = tf.Module()
     module.f = tf_fn
-    tf.saved_model.save(module, path)
+    # explicit serving signature so native runners (C API,
+    # native/savedmodel_runner.cc) find serving_default_arg0 /
+    # StatefulPartitionedCall ops
+    tf.saved_model.save(
+        module, path,
+        signatures={"serving_default": tf_fn.get_concrete_function()})
     return True
 
 

@@ -1,0 +1,115 @@
+// Native C++ inference runner over the TensorFlow C API — the TPU-era
+// successor of the reference's C++ deployment demos (others/deploy/
+// onnx2trt/inference_trt.cpp:105 TensorRT engine runner and YOLOX's C++
+// demos): load the jax2tf-exported SavedModel (export/serialize.py
+// export_savedmodel), feed a float32 NHWC tensor, run the
+// serving_default signature, print the output logits.
+//
+//   savedmodel_runner <export_dir> <input_op> <output_op> d0,d1,...
+//
+// Op names come from the SavedModel signature (printed by
+// export/serialize.py when exporting, typically
+// serving_default_<arg>:0 -> StatefulPartitionedCall:0).
+//
+// Built by tools/build_savedmodel_runner.py:
+//   g++ -O2 -std=c++17 savedmodel_runner.cc -I<tf>/include
+//       -L<tf> -l:libtensorflow_cc.so.2 -Wl,-rpath,<tf>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tensorflow/c/c_api.h"
+
+static void check(TF_Status* s, const char* what) {
+  if (TF_GetCode(s) != TF_OK) {
+    std::fprintf(stderr, "%s failed: %s\n", what, TF_Message(s));
+    std::exit(1);
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: %s <saved_model_dir> <input_op> <output_op> "
+                 "d0,d1,d2,...\n", argv[0]);
+    return 2;
+  }
+  const char* dir = argv[1];
+  std::string in_name = argv[2];
+  std::string out_name = argv[3];
+
+  std::vector<int64_t> dims;
+  int64_t count = 1;
+  for (char* tok = std::strtok(argv[4], ","); tok;
+       tok = std::strtok(nullptr, ",")) {
+    dims.push_back(std::atoll(tok));
+    count *= dims.back();
+  }
+
+  TF_Status* status = TF_NewStatus();
+  TF_Graph* graph = TF_NewGraph();
+  TF_SessionOptions* opts = TF_NewSessionOptions();
+  const char* tags[] = {"serve"};
+  TF_Session* session = TF_LoadSessionFromSavedModel(
+      opts, nullptr, dir, tags, 1, graph, nullptr, status);
+  check(status, "TF_LoadSessionFromSavedModel");
+
+  // split "name:idx"
+  auto split = [](std::string& s) {
+    int idx = 0;
+    auto pos = s.rfind(':');
+    if (pos != std::string::npos) {
+      idx = std::atoi(s.c_str() + pos + 1);
+      s = s.substr(0, pos);
+    }
+    return idx;
+  };
+  int in_idx = split(in_name);
+  int out_idx = split(out_name);
+  TF_Operation* in_op = TF_GraphOperationByName(graph, in_name.c_str());
+  TF_Operation* out_op = TF_GraphOperationByName(graph, out_name.c_str());
+  if (!in_op || !out_op) {
+    std::fprintf(stderr, "op not found (input %s, output %s)\n",
+                 in_name.c_str(), out_name.c_str());
+    return 1;
+  }
+
+  TF_Tensor* in_tensor = TF_AllocateTensor(
+      TF_FLOAT, dims.data(), (int)dims.size(), count * sizeof(float));
+  float* data = static_cast<float*>(TF_TensorData(in_tensor));
+  // deterministic ramp input so python can cross-check exactly
+  for (int64_t i = 0; i < count; ++i)
+    data[i] = 0.001f * (float)(i % 1000);
+
+  TF_Output inputs[1] = {{in_op, in_idx}};
+  TF_Output outputs[1] = {{out_op, out_idx}};
+  TF_Tensor* out_tensor = nullptr;
+  TF_SessionRun(session, nullptr, inputs, &in_tensor, 1, outputs,
+                &out_tensor, 1, nullptr, 0, nullptr, status);
+  check(status, "TF_SessionRun");
+
+  const float* out_data = static_cast<const float*>(
+      TF_TensorData(out_tensor));
+  int64_t out_count = 1;
+  for (int i = 0; i < TF_NumDims(out_tensor); ++i)
+    out_count *= TF_Dim(out_tensor, i);
+  std::printf("output_shape:");
+  for (int i = 0; i < TF_NumDims(out_tensor); ++i)
+    std::printf(" %lld", (long long)TF_Dim(out_tensor, i));
+  std::printf("\nvalues:");
+  for (int64_t i = 0; i < out_count && i < 16; ++i)
+    std::printf(" %.6f", out_data[i]);
+  std::printf("\n");
+
+  TF_DeleteTensor(in_tensor);
+  TF_DeleteTensor(out_tensor);
+  TF_CloseSession(session, status);
+  TF_DeleteSession(session, status);
+  TF_DeleteGraph(graph);
+  TF_DeleteSessionOptions(opts);
+  TF_DeleteStatus(status);
+  return 0;
+}

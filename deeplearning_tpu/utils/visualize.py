@@ -106,3 +106,28 @@ def confusion_matrix_figure(matrix: np.ndarray,
             ax.text(j, i, f"{matrix[i, j]:.0f}", ha="center", va="center")
     fig.tight_layout()
     return fig
+
+
+def embedding_projection_figure(embeddings: np.ndarray,
+                                labels: Sequence[int]):
+    """2-D PCA scatter of embeddings colored by label — the SupCon
+    t-SNE.py visualization surface (PCA stands in for t-SNE: sklearn is
+    not a dependency; the plot's purpose — eyeballing cluster structure —
+    is served). Returns a matplotlib figure or None."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    x = np.asarray(embeddings, np.float64)
+    x = x - x.mean(0)
+    _, _, vt = np.linalg.svd(x, full_matrices=False)
+    proj = x @ vt[:2].T
+    fig, ax = plt.subplots(figsize=(6, 6))
+    sc = ax.scatter(proj[:, 0], proj[:, 1], c=np.asarray(labels),
+                    cmap="tab10", s=12)
+    fig.colorbar(sc, ax=ax, label="class")
+    ax.set_title("embedding projection (PCA)")
+    fig.tight_layout()
+    return fig

@@ -124,3 +124,40 @@ class TestTrainCLI:
         assert cfg.model.name == "resnet50"      # child override
         assert cfg.data.global_batch == 64       # inherited from base
         assert cfg.data.channels == 3
+
+
+class TestNativeSavedModelRunner:
+    def test_cpp_runner_matches_python(self, tmp_path):
+        import subprocess
+        import tempfile
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError:
+            pytest.skip("tensorflow unavailable")
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from build_savedmodel_runner import build
+        try:
+            binary = build()
+        except Exception:
+            pytest.skip("no toolchain for the TF C API runner")
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.export.serialize import export_savedmodel
+        model = MODELS.build("mnist_fcn", num_classes=3, dtype=jnp.float32)
+        x = jnp.zeros((1, 8, 8, 1))
+        variables = model.init(jax.random.key(0), x, train=False)
+
+        def fn(img):
+            return model.apply(variables, img, train=False)
+        d = str(tmp_path / "sm")
+        if not export_savedmodel(fn, [x], d):
+            pytest.skip("savedmodel export unavailable")
+        ramp = (0.001 * (np.arange(64) % 1000)).astype(
+            np.float32).reshape(1, 8, 8, 1)
+        expected = np.asarray(fn(jnp.asarray(ramp))).reshape(-1)
+        out = subprocess.run(
+            [binary, d, "serving_default_arg0:0",
+             "StatefulPartitionedCall:0", "1,8,8,1"],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-1500:]
+        vals = [float(v) for v in out.stdout.split("values:")[1].split()]
+        np.testing.assert_allclose(vals, expected[:len(vals)], atol=1e-4)

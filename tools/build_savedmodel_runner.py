@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Build the native C++ SavedModel inference runner against the installed
+TensorFlow's C API (the onnx2trt .cpp build-step successor). Prints the
+binary path."""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build() -> str:
+    import tensorflow as tf
+    tf_dir = os.path.dirname(tf.__file__)
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning_tpu", "native")
+    src = os.path.join(src_dir, "savedmodel_runner.cc")
+    out = os.path.join(src_dir, "savedmodel_runner")
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", src,
+           f"-I{os.path.join(tf_dir, 'include')}",
+           f"-L{tf_dir}", "-l:libtensorflow_cc.so.2", "-l:libtensorflow_framework.so.2",
+           f"-Wl,-rpath,{tf_dir}", "-o", out]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    print(build())
