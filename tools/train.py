@@ -69,6 +69,8 @@ class TrainCfg:
     ema: bool = False
     workdir: Optional[str] = None
     mesh_model_axis: int = 1         # >1 enables tensor parallelism
+    accum_steps: int = 1             # gradient accumulation microbatches
+    mixup: bool = False              # mixup/cutmix soft targets
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,10 +140,31 @@ def main(argv=None) -> int:
     eval_loader = DataLoader(ArraySource(image=images, label=labels),
                              global_batch=cfg.data.global_batch,
                              mesh=mesh, shuffle=False)
+    if cfg.data.global_batch % max(cfg.train.accum_steps, 1):
+        raise ValueError(
+            f"data.global_batch={cfg.data.global_batch} must be divisible "
+            f"by train.accum_steps={cfg.train.accum_steps}")
+    base_step = make_train_step(
+        make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh,
+        accum_steps=cfg.train.accum_steps)
+    if cfg.train.mixup:
+        from deeplearning_tpu.core import rng as rng_mod
+        from deeplearning_tpu.data.mixup import mixup_cutmix
+
+        def train_step(s, batch, rng):
+            # fold the step in HERE: the Trainer hands the same run key
+            # every iteration (step-folding otherwise happens inside
+            # base_step, after augmentation would already have run)
+            aug_key = rng_mod.step_key(jax.random.fold_in(rng, 1), s.step)
+            batch = mixup_cutmix(batch, aug_key, cfg.model.num_classes,
+                                 smoothing=cfg.train.label_smoothing)
+            return base_step(s, batch, rng)
+        train_step = jax.jit(train_step, donate_argnums=(0,))
+    else:
+        train_step = base_step
     trainer = Trainer(
         state=state,
-        train_step=make_train_step(
-            make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh),
+        train_step=train_step,
         train_loader=loader,
         eval_step=make_eval_step(make_metric_fn()),
         eval_loader=eval_loader,
