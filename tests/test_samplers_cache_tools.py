@@ -1,4 +1,4 @@
-"""Samplers, zip/memmap caches, predict/export CLIs."""
+"""Samplers, zip/memmap caches, predict/export/evaluate CLIs."""
 
 import os
 import subprocess
@@ -112,3 +112,16 @@ class TestToolCLIs:
         assert out.returncode == 0, out.stderr[-2000:]
         assert os.path.getsize(out_path) > 0
         assert "FLOPs" in out.stdout
+
+    def test_evaluate_cli(self, tmp_path):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 3, 64).astype(np.int32)
+        images = rng.normal(0, 0.1, (64, 16, 16, 1)).astype(np.float32)
+        np.savez(tmp_path / "d.npz", images=images, labels=labels)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "evaluate.py"),
+             "--model", "mnist_fcn", "--num-classes", "3",
+             "--npz", str(tmp_path / "d.npz"), "--batch", "32"],
+            capture_output=True, text=True, timeout=300, env=ENV)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert '"top1"' in out.stdout and '"per_class_acc"' in out.stdout

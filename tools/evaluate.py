@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Standalone evaluation CLI — the per-project val.py / test.py successor.
+
+  python tools/evaluate.py --model resnet18 --num-classes 10 \\
+      --npz data.npz [--ckpt runs/x/ckpt/best] [--batch 64]
+
+Runs the eval step over a dataset and prints top-1/top-5 plus per-class
+accuracy from the confusion matrix (the reference's test.py writes a
+results txt; here metrics go to stdout and optionally a json file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--npz", required=True,
+                    help="npz with model-ready 'images' and 'labels'")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--out-json", default=None)
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.core.checkpoint import load_pytree
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
+                                                     miou_from_confusion,
+                                                     topk_correct)
+
+    blob = np.load(args.npz)
+    images, labels = blob["images"], blob["labels"]
+    model = MODELS.build(args.model, num_classes=args.num_classes)
+    variables = model.init(jax.random.key(0),
+                           jnp.asarray(images[:1]), train=False)
+    if args.ckpt:
+        restored = load_pytree(args.ckpt)
+        params = restored.get("params", restored) \
+            if isinstance(restored, dict) else restored
+        variables = {**variables, "params": params}
+
+    @jax.jit
+    def eval_batch(imgs, labs):
+        logits = model.apply(variables, imgs, train=False)
+        counts = topk_correct(logits, labs)
+        cm = confusion_matrix(jnp.argmax(logits, -1), labs,
+                              args.num_classes)
+        return counts, cm
+
+    totals = {"top1": 0, "top5": 0, "count": 0}
+    cm_total = np.zeros((args.num_classes, args.num_classes), np.int64)
+    n = (len(images) // args.batch) * args.batch
+    for start in range(0, n, args.batch):
+        counts, cm = eval_batch(
+            jnp.asarray(images[start:start + args.batch]),
+            jnp.asarray(labels[start:start + args.batch]))
+        for k in totals:
+            totals[k] += int(counts[k])
+        cm_total += np.asarray(cm)
+
+    count = max(totals["count"], 1)
+    stats = miou_from_confusion(cm_total)
+    results = {
+        "top1": totals["top1"] / count,
+        "top5": totals["top5"] / count,
+        "count": count,
+        "per_class_acc": [round(float(a), 4)
+                          for a in stats["class_acc"]],
+    }
+    print(json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in results.items()}))
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            json.dump(results, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
