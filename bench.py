@@ -21,6 +21,7 @@ import numpy as np
 # erroring). If the benchmark hasn't printed within the deadline, emit a
 # clearly-marked fallback line so the driver always records something.
 _DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "900"))
+_PROBE_DEADLINE_S = int(os.environ.get("BENCH_PROBE_DEADLINE_S", "60"))
 _DONE = threading.Event()
 
 
@@ -31,6 +32,34 @@ def _watchdog():
             "vs_baseline": 0.0, "error": "timeout: device unreachable "
             f"within {_DEADLINE_S}s (tunnel wedge)"}), flush=True)
         os._exit(2)
+
+
+def _health_probe():
+    """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
+    must complete within _PROBE_DEADLINE_S, else report and exit instead of
+    burning the whole bench budget discovering the tunnel is down."""
+    ok = threading.Event()
+
+    def probe_watchdog():
+        if not ok.wait(_PROBE_DEADLINE_S):
+            print(json.dumps({
+                "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
+                "vs_baseline": 0.0, "error": "health probe timeout: device "
+                f"unreachable within {_PROBE_DEADLINE_S}s (tunnel wedge)"}),
+                flush=True)
+            os._exit(3)
+
+    threading.Thread(target=probe_watchdog, daemon=True).start()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    val = float(jnp.asarray(x @ x, jnp.float32)[0, 0])  # D2H forces sync
+    if val != 256.0:
+        print(json.dumps({
+            "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
+            "vs_baseline": 0.0,
+            "error": f"health probe wrong result: {val} != 256.0"}),
+            flush=True)
+        os._exit(4)
+    ok.set()
 
 PEAK_BF16_FLOPS = {
     # per-chip dense bf16 peak; device_kind substring -> FLOP/s
@@ -53,6 +82,7 @@ def peak_flops(device) -> float:
 
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
+    _health_probe()
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.train import TrainState, make_train_step
     from deeplearning_tpu.train.classification import make_loss_fn

@@ -1,1 +1,1 @@
-from . import classification, detection, metric, segmentation, ssl, stereo  # noqa: F401
+from . import classification, detection, metric, pose, segmentation, ssl, stereo  # noqa: F401

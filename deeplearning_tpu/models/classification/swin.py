@@ -187,6 +187,67 @@ class SwinBlock(nn.Module):
         return x + DropPath(self.drop_path_rate)(y, deterministic)
 
 
+class SwinMLPBlock(nn.Module):
+    """Swin-MLP block (swin_mlp.py:59-156): window attention replaced by a
+    grouped token-mixing linear map — per head, a learned (win², win²)
+    matrix over window positions (the reference's grouped Conv1d over
+    nH·win² channels). Shifted blocks zero-pad by (window−shift, shift)
+    on each spatial side and crop back, instead of cyclic roll + mask.
+
+    TPU-first: the token mix is one batched einsum over
+    (windows × heads) — an MXU matmul, no conv needed.
+    """
+    dim: int
+    input_resolution: Tuple[int, int]
+    num_heads: int
+    window: int = 7
+    shift: int = 0
+    mlp_ratio: float = 4.0
+    drop: float = 0.0
+    drop_path_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h, w = self.input_resolution
+        b, n, c = x.shape
+        window = min(self.window, h, w)
+        shift = 0 if window >= min(h, w) else self.shift
+        d = c // self.num_heads
+        n_win = window * window
+
+        shortcut = x
+        x = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        x = x.reshape(b, h, w, c)
+        if shift > 0:
+            # P_l = P_t = window - shift, P_r = P_b = shift (swin_mlp.py:91)
+            pt, pb = window - shift, shift
+            x = jnp.pad(x, ((0, 0), (pt, pb), (pt, pb), (0, 0)))
+        hh, ww = x.shape[1], x.shape[2]
+        wins = wu.window_partition(x, window)          # (B·nW, win², C)
+        nwb = wins.shape[0]
+        wins = wins.reshape(nwb, n_win, self.num_heads, d)
+        kernel = self.param(
+            "spatial_mlp_kernel", nn.initializers.lecun_normal(),
+            (self.num_heads, n_win, n_win), jnp.float32)
+        bias = self.param("spatial_mlp_bias", nn.initializers.zeros,
+                          (self.num_heads, n_win), jnp.float32)
+        wins = jnp.einsum("nihd,hoi->nohd", wins,
+                          kernel.astype(wins.dtype)) \
+            + bias.T[None, :, :, None].astype(wins.dtype)
+        wins = wins.reshape(nwb, n_win, c)
+        x = wu.window_merge(wins, window, hh, ww)
+        if shift > 0:
+            x = x[:, pt:pt + h, pt:pt + w, :]
+        x = x.reshape(b, n, c)
+        x = shortcut + DropPath(self.drop_path_rate)(x, deterministic)
+
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = Mlp(self.mlp_ratio, self.drop, self.dtype, name="mlp")(
+            y, deterministic)
+        return x + DropPath(self.drop_path_rate)(y, deterministic)
+
+
 class PatchMerging(nn.Module):
     """2×2 patch merge + channel double (swin_transformer.py:308). v2 moves
     the norm AFTER the reduction (res-post-norm, over 2C not 4C)."""
@@ -198,8 +259,11 @@ class PatchMerging(nn.Module):
     def __call__(self, x):
         h, w = self.input_resolution
         b, n, c = x.shape
+        # channel order matches the reference concat [x0;x1;x2;x3] =
+        # [(0,0),(1,0),(0,1),(1,1)] over (h-sub, w-sub), so pretrained
+        # reduction/norm weights load without a channel permutation
         x = x.reshape(b, h // 2, 2, w // 2, 2, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // 2) * (w // 2),
+        x = x.transpose(0, 1, 3, 4, 2, 5).reshape(b, (h // 2) * (w // 2),
                                                   4 * c)
         if self.v2:
             x = nn.Dense(2 * c, use_bias=False, dtype=self.dtype,
@@ -229,6 +293,7 @@ class SwinTransformer(nn.Module):
     use_pallas: bool = False
     moe: bool = False                 # MoE MLP in every 2nd block
     num_experts: int = 8
+    spatial_mlp: bool = False         # Swin-MLP (swin_mlp.py) blocks
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -250,16 +315,25 @@ class SwinTransformer(nn.Module):
         for stage, (depth, heads) in enumerate(zip(self.depths,
                                                    self.num_heads)):
             for i in range(depth):
-                blk = SwinBlock
-                if self.remat:
-                    blk = nn.remat(SwinBlock, static_argnums=(2,))
-                x = blk(dim, res, heads, self.window,
-                        0 if i % 2 == 0 else self.window // 2,
-                        self.mlp_ratio, self.qkv_bias, self.drop_rate,
-                        float(dpr[block_idx]), self.v2, self.dtype,
-                        self.use_pallas,
-                        self.moe and i % 2 == 1, self.num_experts,
-                        name=f"stage{stage}_block{i}")(x, deterministic)
+                shift = 0 if i % 2 == 0 else self.window // 2
+                if self.spatial_mlp:
+                    blk = SwinMLPBlock
+                    if self.remat:
+                        blk = nn.remat(SwinMLPBlock, static_argnums=(2,))
+                    x = blk(dim, res, heads, self.window, shift,
+                            self.mlp_ratio, self.drop_rate,
+                            float(dpr[block_idx]), self.dtype,
+                            name=f"stage{stage}_block{i}")(x, deterministic)
+                else:
+                    blk = SwinBlock
+                    if self.remat:
+                        blk = nn.remat(SwinBlock, static_argnums=(2,))
+                    x = blk(dim, res, heads, self.window, shift,
+                            self.mlp_ratio, self.qkv_bias, self.drop_rate,
+                            float(dpr[block_idx]), self.v2, self.dtype,
+                            self.use_pallas,
+                            self.moe and i % 2 == 1, self.num_experts,
+                            name=f"stage{stage}_block{i}")(x, deterministic)
                 block_idx += 1
             if stage < len(self.depths) - 1:
                 x = PatchMerging(res, self.dtype, self.v2,
@@ -305,3 +379,13 @@ swinv2_base_patch4_window7_224 = _factory(
 swin_moe_tiny_patch4_window7_224 = _factory(
     "swin_moe_tiny_patch4_window7_224", embed_dim=96, depths=(2, 2, 6, 2),
     num_heads=(3, 6, 12, 24), moe=True)
+# Swin-MLP variants (swin_mlp.py; configs/swin_mlp_*.yaml): cN = head dim,
+# heads per stage = stage dim / N
+swin_mlp_tiny_c24_patch4_window8_256 = _factory(
+    "swin_mlp_tiny_c24_patch4_window8_256", embed_dim=96,
+    depths=(2, 2, 6, 2), num_heads=(4, 8, 16, 32), window=8,
+    spatial_mlp=True)
+swin_mlp_base_patch4_window7_224 = _factory(
+    "swin_mlp_base_patch4_window7_224", embed_dim=128,
+    depths=(2, 2, 18, 2), num_heads=(4, 8, 16, 32), window=7,
+    spatial_mlp=True)

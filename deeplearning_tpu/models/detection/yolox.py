@@ -40,6 +40,7 @@ class ConvBnSiLU(nn.Module):
     stride: int = 1
     groups: int = 1
     dtype: Any = jnp.bfloat16
+    act: str = "silu"      # "lrelu" for the yolov3/YOLOFPN path
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -49,7 +50,7 @@ class ConvBnSiLU(nn.Module):
                     dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.97,
                          epsilon=1e-3, dtype=self.dtype, name="bn")(x)
-        return nn.silu(x)
+        return nn.leaky_relu(x, 0.1) if self.act == "lrelu" else nn.silu(x)
 
 
 class Bottleneck(nn.Module):
@@ -180,25 +181,111 @@ class PAFPN(nn.Module):
         return [p3, n4, n5]
 
 
+class ResLayer(nn.Module):
+    """Darknet residual: 1×1 halve + 3×3 restore, lrelu (darknet.py
+    ResLayer)."""
+    ch: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = ConvBnSiLU(self.ch // 2, 1, dtype=self.dtype, act="lrelu",
+                       name="c1")(x, train)
+        y = ConvBnSiLU(self.ch, 3, dtype=self.dtype, act="lrelu",
+                       name="c2")(y, train)
+        return x + y
+
+
+class Darknet53(nn.Module):
+    """Darknet-53 backbone (darknet.py Darknet, depth 53: residual groups
+    1/2/8/8/4) with the SPP block YOLOFPN appends to dark5."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = ConvBnSiLU(32, 3, dtype=self.dtype, act="lrelu",
+                       name="stem")(x.astype(self.dtype), train)
+
+        def group(y, ch, n, name):
+            y = ConvBnSiLU(ch, 3, 2, dtype=self.dtype, act="lrelu",
+                           name=f"{name}_down")(y, train)
+            for i in range(n):
+                y = ResLayer(ch, self.dtype, name=f"{name}_res{i}")(
+                    y, train)
+            return y
+
+        y = group(y, 64, 1, "d1")
+        y = group(y, 128, 2, "d2")
+        c3 = y = group(y, 256, 8, "d3")
+        c4 = y = group(y, 512, 8, "d4")
+        y = group(y, 1024, 4, "d5")
+        # make_spp_block: 1×1/3×3 pre, multi-scale max-pool concat, 1×1
+        # bottleneck out at 512ch (yolo_fpn.py)
+        y = ConvBnSiLU(512, 1, dtype=self.dtype, act="lrelu",
+                       name="spp_pre1")(y, train)
+        y = ConvBnSiLU(1024, 3, dtype=self.dtype, act="lrelu",
+                       name="spp_pre2")(y, train)
+        pools = [y] + [nn.max_pool(y, (k, k), strides=(1, 1),
+                                   padding="SAME") for k in (5, 9, 13)]
+        y = jnp.concatenate(pools, axis=-1)
+        y = ConvBnSiLU(512, 1, dtype=self.dtype, act="lrelu",
+                       name="spp_post1")(y, train)
+        y = ConvBnSiLU(1024, 3, dtype=self.dtype, act="lrelu",
+                       name="spp_post2")(y, train)
+        c5 = ConvBnSiLU(512, 1, dtype=self.dtype, act="lrelu",
+                        name="spp_out")(y, train)
+        return {"c3": c3, "c4": c4, "c5": c5}
+
+
+class YOLOFPN(nn.Module):
+    """yolo_fpn.py: two top-down upsample+concat "embedding" branches
+    (five alternating 1×1/3×3 lrelu convs each) over Darknet-53
+    features — the yolov3 exp's neck."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        def up(x):
+            b, h, w, c = x.shape
+            return jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+
+        def embed(y, ch, name):
+            for i, (k, f) in enumerate(
+                    [(1, ch), (3, ch * 2), (1, ch), (3, ch * 2), (1, ch)]):
+                y = ConvBnSiLU(f, k, dtype=self.dtype, act="lrelu",
+                               name=f"{name}_{i}")(y, train)
+            return y
+
+        c3, c4, c5 = feats["c3"], feats["c4"], feats["c5"]
+        x1 = ConvBnSiLU(256, 1, dtype=self.dtype, act="lrelu",
+                        name="out1_cbl")(c5, train)
+        p4 = embed(jnp.concatenate([up(x1), c4], -1), 256, "out1")
+        x2 = ConvBnSiLU(128, 1, dtype=self.dtype, act="lrelu",
+                        name="out2_cbl")(p4, train)
+        p3 = embed(jnp.concatenate([up(x2), c3], -1), 128, "out2")
+        return [p3, p4, c5]
+
+
 class YOLOXHead(nn.Module):
     num_classes: int = 80
     width_mult: float = 0.5
     dtype: Any = jnp.bfloat16
+    act: str = "silu"
 
     @nn.compact
     def __call__(self, feats, train: bool = False):
         w = int(256 * self.width_mult)
         outs = []
         for li, x in enumerate(feats):
-            x = ConvBnSiLU(w, 1, dtype=self.dtype,
+            x = ConvBnSiLU(w, 1, dtype=self.dtype, act=self.act,
                            name=f"stem{li}")(x, train)
             c = x
             for i in range(2):
-                c = ConvBnSiLU(w, 3, dtype=self.dtype,
+                c = ConvBnSiLU(w, 3, dtype=self.dtype, act=self.act,
                                name=f"cls{li}_{i}")(c, train)
             r = x
             for i in range(2):
-                r = ConvBnSiLU(w, 3, dtype=self.dtype,
+                r = ConvBnSiLU(w, 3, dtype=self.dtype, act=self.act,
                                name=f"reg{li}_{i}")(r, train)
             cls = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
                           bias_init=nn.initializers.constant(
@@ -221,9 +308,17 @@ class YOLOX(nn.Module):
     depth_mult: float = 0.33
     width_mult: float = 0.5
     dtype: Any = jnp.bfloat16
+    backbone_type: str = "cspdarknet"   # "darknet53" = yolov3 exp variant
 
     @nn.compact
     def __call__(self, images, train: bool = False):
+        if self.backbone_type == "darknet53":
+            # exps/default/yolov3.py: YOLOFPN backbone + lrelu head
+            feats = Darknet53(self.dtype, name="backbone")(images, train)
+            pyramid = YOLOFPN(self.dtype, name="neck")(feats, train)
+            return YOLOXHead(self.num_classes, self.width_mult,
+                             self.dtype, act="lrelu",
+                             name="head")(pyramid, train)
         feats = CSPDarknet(self.depth_mult, self.width_mult, self.dtype,
                            name="backbone")(images, train)
         pyramid = PAFPN(self.width_mult, self.depth_mult, self.dtype,
@@ -287,7 +382,11 @@ def simota_assign(decoded: jax.Array, centers: jax.Array,
     cls_cost = -(onehot[:, None, :] * jnp.log(joint)
                  + (1 - onehot[:, None, :]) * jnp.log(1 - joint + 1e-8))
     cls_cost = jnp.sum(cls_cost, -1)                      # (G, A)
-    cost = cls_cost + 3.0 * iou_cost + 1e5 * (~fg_cand)
+    # reference adds an extra 1e5 for candidates not in BOTH box and
+    # center (yolo_head.py get_assignments cost), preferring anchors that
+    # satisfy both gates; non-candidates end up at 2e5, strictly worse.
+    cost = (cls_cost + 3.0 * iou_cost + 1e5 * (~fg_cand)
+            + 1e5 * (~(in_box & in_center)))
 
     # dynamic k per gt: clamp(sum of top-10 candidate IoUs, min 1)
     masked_iou = jnp.where(fg_cand, iou, 0.0)
@@ -401,3 +500,11 @@ for _name, (_d, _w) in _VARIANTS.items():
                          width_mult=ww, **kw)
         return build
     MODELS.register(_name)(_mk(_d, _w))
+
+
+@MODELS.register("yolox_yolov3")
+def yolox_yolov3(num_classes: int = 80, **kw):
+    """exps/default/yolov3.py: Darknet-53 + YOLOFPN + lrelu decoupled
+    head at width 1.0."""
+    return YOLOX(num_classes=num_classes, depth_mult=1.0, width_mult=1.0,
+                 backbone_type="darknet53", **kw)

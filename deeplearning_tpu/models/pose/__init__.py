@@ -1,0 +1,1 @@
+from . import hrnet_pose  # noqa: F401

@@ -1,0 +1,28 @@
+"""HRNet keypoint (pose) models — pose_estimation/Insulator parity.
+
+The reference project (pose_estimation/Insulator: models/hrnet.py,
+utils/loss.py:6 KpLoss) predicts per-joint heatmaps at stride 4 from an
+HRNet trunk. The trunk is shared with the segmentation family
+(models/segmentation/hrnet.py); only the head differs. Heatmap targets /
+decode / OKS evaluation are in evaluation/keypoints.py, the affine crop
+data path in data/keypoint_transforms.py, and the visibility-weighted
+MSE loss in ops/losses.heatmap_mse_loss.
+"""
+
+from __future__ import annotations
+
+from ...core.registry import MODELS
+from ..segmentation.hrnet import HRNet
+
+
+@MODELS.register("hrnet_w18_keypoints")
+def hrnet_w18_keypoints(num_classes: int = 17, **kw):
+    """num_classes = number of keypoints (heatmap channels)."""
+    return HRNet(num_classes=num_classes, base_width=18, head="keypoints",
+                 **kw)
+
+
+@MODELS.register("hrnet_w48_keypoints")
+def hrnet_w48_keypoints(num_classes: int = 17, **kw):
+    return HRNet(num_classes=num_classes, base_width=48, head="keypoints",
+                 **kw)

@@ -149,8 +149,5 @@ def hrnet_w48_seg(num_classes: int = 19, **kw):
     return HRNet(num_classes=num_classes, base_width=48, head="seg", **kw)
 
 
-@MODELS.register("hrnet_w18_keypoints")
-def hrnet_w18_keypoints(num_classes: int = 17, **kw):
-    """num_classes = number of keypoints (heatmap channels)."""
-    return HRNet(num_classes=num_classes, base_width=18, head="keypoints",
-                 **kw)
+# the keypoint-head variants live in models/pose/ (pose_estimation/
+# Insulator parity) and reuse this HRNet trunk

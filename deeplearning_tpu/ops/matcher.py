@@ -32,13 +32,15 @@ def match_anchors(iou: jax.Array, gt_valid: jax.Array,
         best_iou >= high_threshold, best_gt,
         jnp.where(best_iou >= low_threshold, BETWEEN, BELOW_LOW))
     if allow_low_quality:
-        # for each valid gt, force-match its highest-IoU anchors (ties incl.)
+        # for each valid gt, force-match its highest-IoU anchors (ties
+        # incl.). torchvision's Matcher restores the anchor's OWN
+        # pre-threshold best match (all_matches), which may be a different
+        # gt than the one it is best-anchor for — mirror that semantics.
         best_anchor_iou = jnp.max(iou, axis=1, keepdims=True)   # (G, 1)
         is_best = (iou >= best_anchor_iou - 1e-7) & (best_anchor_iou > 0) \
             & gt_valid[:, None]
         force = jnp.any(is_best, axis=0)
-        forced_gt = jnp.argmax(is_best, axis=0)
-        matches = jnp.where(force, forced_gt, matches)
+        matches = jnp.where(force, best_gt, matches)
     return matches
 
 

@@ -9,8 +9,9 @@ recomputes P = exp(S - LSE) blockwise from the saved logsumexp, FlashAttention-2
 style.
 
 Ring attention (parallel/ring_attention.py) is the sequence-parallel
-counterpart; it currently uses its own lax per-chunk attention (this
-kernel's lse is saved for the VJP but not exposed publicly yet).
+counterpart; ``flash_attention_with_lse`` exposes the per-row logsumexp
+so the ring's online-softmax merge can combine per-chunk kernel outputs
+exactly — blockwise HBM savings and ring scaling stack.
 
 Layout: (B, H, N, D). N must be a multiple of the block size — wrappers
 pad and mask via ``kv_len`` (the number of valid key tokens).
@@ -382,6 +383,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         v = jnp.pad(v, pad)
     out = _flash(q, k, v, sm_scale, n, causal, block_q, block_k)
     return out[:, :, :n, :]
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                             sm_scale: Optional[float] = None,
+                             causal: bool = False,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K):
+    """Forward pass returning (out, lse): out (B, H, N, D) and the
+    per-row logsumexp (B, H, N) of the scaled scores. This is the hook
+    ring attention uses to merge per-chunk kernel results exactly —
+    chunks combine as out = Σᵢ outᵢ·exp(lseᵢ − LSE), LSE = logsumexpᵢ.
+    Forward-only (no custom VJP through the pair)."""
+    b, h, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    block_q = min(block_q, _round_block(n))
+    block_k = min(block_k, _round_block(n))
+    n_pad = -n % math.lcm(block_q, block_k)
+    if n_pad:
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    out, res = _flash_fwd(q, k, v, sm_scale, n, causal, block_q, block_k)
+    lse = res[4][:, :, 0].reshape(b, h, n + n_pad)
+    return out[:, :, :n, :], lse[:, :, :n]
 
 
 def _round_block(n: int) -> int:

@@ -98,6 +98,7 @@ class MoEMlp(nn.Module):
         aux = jnp.zeros((), jnp.float32)
         remaining = probs
         used = jnp.zeros((e,), jnp.float32)   # slots taken in prior rounds
+        gate_sum = jnp.zeros((t,), jnp.float32)  # selected in-capacity mass
         for k in range(self.top_k):
             choice = jnp.argmax(remaining, axis=-1)              # (T,)
             gate = jnp.take_along_axis(remaining, choice[:, None],
@@ -114,8 +115,15 @@ class MoEMlp(nn.Module):
             cap_onehot = jax.nn.one_hot(pos_idx, capacity) \
                 * (mask * in_cap)[..., None]                     # (T,E,C)
             combine = combine + gate[:, None, None] * cap_onehot
+            gate_sum = gate_sum + gate * jnp.sum(mask * in_cap, axis=-1)
             used = used + jnp.sum(mask, axis=0)
             remaining = remaining * (1.0 - mask)
+
+        if self.top_k > 1:
+            # tutel/swin-moe normalize the selected top-k gates to sum to 1
+            # (masked to in-capacity selections) so multi-expert outputs
+            # are not systematically down-scaled
+            combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
 
         dispatch = (combine > 0).astype(tokens.dtype)            # (T,E,C)
         expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)

@@ -53,21 +53,35 @@ def _combine(carry, update):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = SEQ_AXIS,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   use_flash: bool = False) -> jax.Array:
     """Exact attention with K/V ring-rotated over ``axis_name``.
 
     Must run inside shard_map with ``axis_name`` bound; q/k/v are the
     device-local sequence chunks (B, H, Nlocal, D). Non-causal (the zoo's
     encoders are bidirectional).
+
+    ``use_flash`` runs each chunk through the Pallas flash kernel
+    (flash_attention_with_lse): a chunk's (out, lse) is an equivalent
+    online-softmax accumulator (num=out, m=lse, l=1), so the ring merge
+    is exact and never materializes a (Nlocal, Nlocal) score matrix in
+    HBM. Forward-only — the default lax path stays differentiable.
     """
     axis_size = jax.lax.axis_size(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    def chunk_stats(q, kk, vv):
+        if use_flash:
+            from ..ops.pallas.flash_attention import flash_attention_with_lse
+            o, lse = flash_attention_with_lse(q, kk, vv, sm_scale=sm_scale)
+            return (o.astype(jnp.float32), lse, jnp.ones_like(lse))
+        return _chunk_attention_stats(q, kk, vv, sm_scale)
+
     def body(i, state):
         carry, kk, vv = state
-        update = _chunk_attention_stats(q, k=kk, v=vv, sm_scale=sm_scale)
+        update = chunk_stats(q, kk, vv)
         carry = _combine(carry, update)
         # rotate KV to the next device; last iteration's rotate is wasted
         # but keeps the loop body uniform (XLA overlaps it with compute).
@@ -91,7 +105,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return (num / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = SEQ_AXIS):
+def make_ring_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
+                        use_flash: bool = False):
     """shard_map-wrapped ring attention over a live mesh: takes globally
     sharded (B, H, N, D) arrays (sequence dim sharded over ``axis_name``)
     and returns the same sharding."""
@@ -99,11 +114,13 @@ def make_ring_attention(mesh: Mesh, axis_name: str = SEQ_AXIS):
 
     spec = P(None, None, axis_name, None)
 
+    # pallas_call out_shapes carry no varying-mesh-axes info, so the
+    # flash-backed path needs shard_map's vma check off
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=spec)
+        out_specs=spec, check_vma=not use_flash)
     def fn(q, k, v):
-        return ring_attention(q, k, v, axis_name)
+        return ring_attention(q, k, v, axis_name, use_flash=use_flash)
 
     return fn

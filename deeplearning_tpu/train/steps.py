@@ -63,22 +63,42 @@ def make_train_step(
         if accum_steps == 1:
             (loss, aux), grads = grad_fn(state.params, state, batch, rng)
         else:
+            # batch_stats thread through the scan carry so every
+            # microbatch's forward sees the stats advanced by the previous
+            # one (matching torch BN across accum_steps forwards), and
+            # metrics are averaged over microbatches instead of reporting
+            # only the last one.
+            aux_proto = _abstract_aux(loss_fn, state, batch, rng,
+                                      accum_steps)
+            has_stats = "batch_stats" in aux_proto
+
             def body(carry, i):
-                grads_acc, loss_acc, _ = carry
+                grads_acc, loss_acc, aux_acc = carry
                 mb = _microbatch(batch, accum_steps, i)
-                (l, a), g = grad_fn(state.params, state,
+                st = (state.replace(batch_stats=aux_acc["batch_stats"])
+                      if has_stats else state)
+                (l, a), g = grad_fn(state.params, st,
                                     mb, jax.random.fold_in(rng, i))
                 grads_acc = jax.tree.map(jnp.add, grads_acc, g)
-                return (grads_acc, loss_acc + l, a), None
+                new_aux = dict(a)
+                if "metrics" in a:
+                    new_aux["metrics"] = jax.tree.map(
+                        jnp.add, aux_acc.get("metrics", {}), a["metrics"])
+                return (grads_acc, loss_acc + l, new_aux), None
 
+            init_aux = dict(aux_proto)   # leaves are already jnp.zeros
+            if has_stats:
+                init_aux["batch_stats"] = state.batch_stats
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
             (grads, loss, aux), _ = jax.lax.scan(
-                body, (zero_grads, jnp.zeros((), jnp.float32), _abstract_aux(
-                    loss_fn, state, batch, rng, accum_steps)),
+                body, (zero_grads, jnp.zeros((), jnp.float32), init_aux),
                 jnp.arange(accum_steps))
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = loss / accum_steps
+            if "metrics" in aux:
+                aux["metrics"] = jax.tree.map(
+                    lambda m: m / accum_steps, aux["metrics"])
 
         new_stats = aux.get("batch_stats")
         state = state.apply_gradients(grads, new_stats)

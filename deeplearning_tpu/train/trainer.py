@@ -64,6 +64,7 @@ class Trainer:
         best_metric: str = "top1",
         callbacks: Optional[Callbacks] = None,
         metric_reducer: Optional[Callable[[Dict], Dict]] = None,
+        abort_non_finite: bool = True,
     ):
         self.state = state
         self.train_step = train_step
@@ -78,6 +79,7 @@ class Trainer:
         self.best_value = float("-inf")
         self.callbacks = callbacks or Callbacks()
         self.metric_reducer = metric_reducer
+        self.abort_non_finite = abort_non_finite
         self.logger = create_logger("dltpu", workdir)
         self.tb = TensorBoardWriter(workdir)
         self.meters = MetricLogger()
@@ -121,6 +123,19 @@ class Trainer:
             if it % self.log_every == 0:
                 # scalar fetch both syncs and feeds the meters
                 host = {k: float(v) for k, v in metrics.items()}
+                # non-finite-loss abort (mnist/utils.py:53-55,
+                # fasterRcnn/train_eval_utils.py:44-47). Checked at the
+                # sync points: a per-iter device fetch would serialize the
+                # TPU pipeline, so divergence is caught within log_every
+                # steps rather than instantly.
+                if self.abort_non_finite and not np.isfinite(
+                        host.get("loss", 0.0)):
+                    self.logger.error(
+                        f"Loss is {host['loss']}, stopping training "
+                        f"(epoch {epoch} it {it})")
+                    raise FloatingPointError(
+                        f"non-finite loss {host['loss']} at epoch "
+                        f"{epoch} it {it}")
                 host["data_time"] = data_time
                 self.meters.update(**host)
                 step = int(self.state.step)

@@ -36,6 +36,40 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_flash_kernel_chunks_match_reference(self):
+        # VERDICT item 7: the Pallas kernel runs INSIDE the ring —
+        # per-chunk (out, lse) merge must reproduce full attention
+        mesh = build_mesh(MeshConfig(data=-1, seq=4))
+        rng = np.random.default_rng(2)
+        b, h, n, d = 1, 2, 64 * 4, 32
+        q = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        ref = reference(q, k, v)
+        sharding = NamedSharding(mesh, P(None, None, "seq", None))
+        qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+        ring = jax.jit(make_ring_attention(mesh, use_flash=True))
+        out = ring(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_with_lse_matches_naive(self):
+        from deeplearning_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse)
+        rng = np.random.default_rng(3)
+        b, h, n, d = 1, 2, 80, 16        # n not a block multiple → padded
+        q = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (b, h, n, d)), jnp.float32)
+        out, lse = flash_attention_with_lse(q, k, v)
+        ref = reference(q, k, v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+        ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_gradients_flow(self):
         mesh = build_mesh(MeshConfig(data=-1, seq=4))
         rng = np.random.default_rng(1)

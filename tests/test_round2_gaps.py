@@ -1,0 +1,269 @@
+"""Round-2 gap closures: Swin-MLP, yolov3 variant, keypoint data path,
+pose registry, non-finite-loss abort, and the ADVICE.md semantic fixes
+(SimOTA both-gate preference, matcher low-quality restore, MoE top-k
+gate normalization, PatchMerging channel order, accumulation metrics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+
+
+class TestSwinMLP:
+    def test_forward_finite_with_shift(self):
+        # 64px/patch4 → 16×16 stage-0 grid with window 8 → shifted blocks
+        # exercise the zero-pad+crop path
+        model = MODELS.build("swin_mlp_tiny_c24_patch4_window8_256",
+                             num_classes=5, dtype=jnp.float32,
+                             drop_path_rate=0.0)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 64, 64, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 5)
+        assert np.all(np.isfinite(np.asarray(out)))
+        # spatial-MLP params present, attention params absent
+        flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+        names = ["/".join(str(k) for k in path) for path, _ in flat]
+        assert any("spatial_mlp_kernel" in n for n in names)
+        assert not any("qkv" in n for n in names)
+
+    def test_registry_base_variant(self):
+        model = MODELS.build("swin_mlp_base_patch4_window7_224",
+                             num_classes=3, dtype=jnp.float32)
+        assert model.spatial_mlp and model.embed_dim == 128
+
+
+class TestPatchMergingOrder:
+    def test_channel_order_matches_reference_concat(self):
+        # the module's reshape/transpose must equal the reference's
+        # [x0;x1;x2;x3] = [(0,0),(1,0),(0,1),(1,1)] slicing over
+        # (h-sub, w-sub) (swin_transformer.py:308)
+        h = w = 4
+        c = 3
+        x = jnp.arange(h * w * c, dtype=jnp.float32).reshape(1, h * w, c)
+        merged = x.reshape(1, h // 2, 2, w // 2, 2, c).transpose(
+            0, 1, 3, 4, 2, 5).reshape(1, (h // 2) * (w // 2), 4 * c)
+        g = x.reshape(1, h, w, c)
+        expected = jnp.concatenate(
+            [g[:, 0::2, 0::2], g[:, 1::2, 0::2],
+             g[:, 0::2, 1::2], g[:, 1::2, 1::2]],
+            axis=-1).reshape(1, (h // 2) * (w // 2), 4 * c)
+        np.testing.assert_array_equal(np.asarray(merged),
+                                      np.asarray(expected))
+
+
+class TestYolov3Variant:
+    def test_forward_shapes(self):
+        model = MODELS.build("yolox_yolov3", num_classes=4,
+                             dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 64, 64, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        # anchors = 8² + 4² + 2² = 84 at strides 8/16/32
+        assert out.shape == (1, 84, 9)
+        assert np.all(np.isfinite(np.asarray(out)))
+        flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+        names = ["/".join(str(k) for k in path) for path, _ in flat]
+        assert any("spp_out" in n for n in names)        # Darknet53 SPP
+        assert any("out1_cbl" in n for n in names)       # YOLOFPN branch
+
+
+class TestSimOTABothGatePreference:
+    def test_prefers_anchor_in_box_and_center(self):
+        from deeplearning_tpu.models.detection.yolox import simota_assign
+        # two anchors with IDENTICAL predictions: anchor0 in-box only,
+        # anchor1 in-box AND in-center → with dynamic_k=1 the reference
+        # cost prefers anchor1 (extra 1e5 for single-gate candidates)
+        centers = jnp.asarray([[0.0, 0.0], [5.0, 0.0]])   # cx = 0.5, 5.5
+        strides = jnp.asarray([1.0, 1.0])
+        pred_box = [0.0, 0.0, 10.0, 0.8]                  # iou 0.4 vs gt
+        decoded = jnp.asarray([pred_box + [0.0] * 3] * 2, jnp.float32)
+        gt_boxes = jnp.asarray([[0.0, 0.0, 10.0, 2.0]])   # center (5, 1)
+        out = simota_assign(decoded, centers, strides, gt_boxes,
+                            jnp.asarray([0]), jnp.asarray([True]),
+                            num_classes=2)
+        fg = np.asarray(out["fg"])
+        assert fg[1] and not fg[0]
+
+
+class TestMatcherLowQualityRestore:
+    def test_restores_anchor_own_best_gt(self):
+        from deeplearning_tpu.ops.matcher import match_anchors
+        # anchor0 is gt0's best anchor (0.3) but itself overlaps gt1 more
+        # (0.4): torchvision restores anchor0's own argmax (gt1)
+        iou = jnp.asarray([[0.3, 0.1],
+                           [0.4, 0.45]])
+        matches = match_anchors(iou, jnp.asarray([True, True]),
+                                high_threshold=0.5, low_threshold=0.45,
+                                allow_low_quality=True)
+        assert int(matches[0]) == 1
+        assert int(matches[1]) == 1
+
+
+class TestMoETopKGateNormalization:
+    def test_identical_experts_reduce_to_plain_mlp(self):
+        from deeplearning_tpu.parallel.moe import MoEMlp
+        # with all experts sharing weights and nothing dropped, a
+        # properly-normalized top-2 combine must equal the single MLP
+        # output exactly (gates sum to 1)
+        moe = MoEMlp(num_experts=2, top_k=2, capacity_factor=8.0,
+                     aux_weight=0.0, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 6, 8)), jnp.float32)
+        params = moe.init(jax.random.key(0), x)["params"]
+        for leaf in ("fc1_kernel", "fc1_bias", "fc2_kernel", "fc2_bias"):
+            arr = params["experts"][leaf]
+            params["experts"][leaf] = jnp.broadcast_to(
+                arr[0][None], arr.shape)
+        out, _ = moe.apply({"params": params}, x)
+
+        def ref_mlp(tokens):
+            k1 = params["experts"]["fc1_kernel"][0]
+            b1 = params["experts"]["fc1_bias"][0]
+            k2 = params["experts"]["fc2_kernel"][0]
+            b2 = params["experts"]["fc2_bias"][0]
+            y = jax.nn.gelu(tokens @ k1 + b1, approximate=True)
+            return y @ k2 + b2
+
+        expected = ref_mlp(x.reshape(-1, 8)).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestAccumulationAux:
+    def test_metrics_averaged_and_stats_advanced(self):
+        from deeplearning_tpu.train import TrainState, make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+        from deeplearning_tpu.train.optim import build_optimizer
+        from deeplearning_tpu.train.schedules import build_schedule
+        import flax.linen as nn
+
+        class BnNet(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                x = x.reshape(x.shape[0], -1)
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.5, name="bn")(x)
+                return nn.Dense(2)(x)
+
+        model = BnNet()
+        x = np.random.default_rng(0).normal(
+            size=(8, 4, 4, 1)).astype(np.float32)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 4, 1)))
+        tx = build_optimizer("sgd", build_schedule("constant",
+                                                   base_lr=0.0),
+                             params=variables["params"])
+        state = TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx,
+            batch_stats=variables["batch_stats"])
+        batch = {"image": jnp.asarray(x),
+                 "label": jnp.asarray([0, 1] * 4, jnp.int32)}
+        loss_fn = make_loss_fn(has_batch_stats=True)
+        step2 = make_train_step(loss_fn, accum_steps=2, donate=False)
+        new_state, metrics = step2(state, batch, jax.random.key(1))
+
+        # batch_stats advance by BOTH microbatches: replaying the two
+        # half-batch BN updates sequentially must give the same mean
+        stats = state.batch_stats
+        for lo, hi in ((0, 4), (4, 8)):
+            _, mut = model.apply(
+                {"params": state.params, "batch_stats": stats},
+                batch["image"][lo:hi], train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": jax.random.key(0)})
+            stats = mut["batch_stats"]
+        np.testing.assert_allclose(
+            np.asarray(new_state.batch_stats["bn"]["mean"]),
+            np.asarray(stats["bn"]["mean"]), rtol=1e-5)
+
+        # metrics are averaged over microbatches: accuracy equals the
+        # mean of the two microbatch accuracies → within [0, 1]
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
+class TestNonFiniteAbort:
+    def test_trainer_raises_on_nan_loss(self):
+        from deeplearning_tpu.train.trainer import Trainer
+
+        class FakeLoader:
+            def __len__(self):
+                return 2
+
+            def set_epoch(self, e):
+                pass
+
+            def __iter__(self):
+                return iter([{"x": np.zeros((2,))}] * 2)
+
+        class FakeState:
+            step = 0
+
+        def bad_step(state, batch, rng):
+            return state, {"loss": jnp.asarray(float("nan"))}
+
+        trainer = Trainer(state=FakeState(), train_step=bad_step,
+                          train_loader=FakeLoader(), epochs=1)
+        with pytest.raises(FloatingPointError):
+            trainer.train()
+
+
+class TestKeypointDataPath:
+    def test_affine_identity_and_rotation(self):
+        from deeplearning_tpu.data import keypoint_transforms as K
+        img = np.random.default_rng(0).normal(
+            size=(32, 24, 3)).astype(np.float32)
+        m = K.get_affine_matrix((0, 0, 24, 32), (32, 24), 0.0)
+        out = K.warp_affine(img, m, (32, 24))
+        np.testing.assert_allclose(out, img, atol=1e-4)
+        # 180° rotation maps a point center-symmetrically
+        m180 = K.get_affine_matrix((0, 0, 24, 32), (32, 24), 180.0)
+        pt = K.affine_points(np.asarray([[2.0, 3.0]]), m180)
+        np.testing.assert_allclose(pt, [[22.0, 29.0]], atol=1e-4)
+
+    def test_invert_affine_roundtrip(self):
+        from deeplearning_tpu.data import keypoint_transforms as K
+        m = K.get_affine_matrix((5, 7, 20, 40), (64, 48), 30.0)
+        inv = K.invert_affine(m)
+        pts = np.asarray([[8.0, 20.0], [15.0, 30.0]])
+        back = K.affine_points(K.affine_points(pts, m), inv)
+        np.testing.assert_allclose(back, pts, atol=1e-3)
+
+    def test_flip_back_and_pairs(self):
+        from deeplearning_tpu.data import keypoint_transforms as K
+        heat = np.zeros((4, 6, 17), np.float32)
+        heat[1, 2, 1] = 1.0          # left joint 1
+        out = K.flip_back(heat)
+        assert out[1, 3, 2] == 1.0   # mirrored column, right joint 2
+
+    def test_train_transform_deterministic_heatmap_peak(self):
+        from deeplearning_tpu.data import keypoint_transforms as K
+        fn = K.keypoint_train_transform(
+            fixed_size=(64, 48), scale_range=(1.0, 1.0),
+            rotation_range=(0.0, 0.0), half_body_prob=0.0, flip_prob=0.0)
+        img = np.zeros((128, 96, 3), np.float32)
+        kps = np.asarray([[48.0, 64.0]] + [[0.0, 0.0]] * 16, np.float32)
+        vis = np.asarray([2.0] + [0.0] * 16, np.float32)
+        out = fn(img, (0, 0, 96, 128), kps, vis)
+        assert out["image"].shape == (64, 48, 3)
+        assert out["heatmaps"].shape == (16, 12, 17)
+        # kp at image center → crop center (24, 32) → heatmap (6, 8)
+        peak = np.unravel_index(np.argmax(out["heatmaps"][..., 0]),
+                                (16, 12))
+        assert peak == (8, 6)
+        assert out["kp_weights"][0] == 1.0 and out["kp_weights"][1] == 0.0
+
+
+class TestPoseRegistry:
+    def test_hrnet_keypoints_moved_to_pose(self):
+        from deeplearning_tpu.models.pose.hrnet_pose import (  # noqa: F401
+            hrnet_w18_keypoints)
+        model = MODELS.build("hrnet_w18_keypoints", num_classes=5,
+                             dtype=jnp.float32, blocks_per_stage=1)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (1, 16, 16, 5)       # stride-4 heatmaps
