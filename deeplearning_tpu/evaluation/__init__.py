@@ -1,1 +1,1 @@
-from . import coco_eval, keypoints, metrics, retrieval, voc  # noqa: F401
+from . import coco_eval, distributed, keypoints, metrics, retrieval, voc  # noqa: F401

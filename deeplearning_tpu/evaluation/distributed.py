@@ -1,0 +1,83 @@
+"""Distributed detection evaluation: per-host shards → global metrics.
+
+The YOLOX pattern (detection/YOLOX/yolox/evaluators/coco_evaluator.py:
+each rank runs inference on its DistributedSampler shard, the per-image
+detection lists are all_gather'd as pickled objects over a gloo CPU
+group (yolox/utils/dist.py:186,128), and rank 0 runs COCOeval) mapped
+to TPU multi-host: detections come out of the jitted postprocess as
+FIXED-SHAPE padded arrays (boxes/scores/labels + valid mask), so the
+object-pickle gather becomes a plain array gather —
+``parallel.collectives.host_allgather`` (jax.experimental
+multihost_utils.process_allgather) — and every host can then fill the
+evaluator identically (no rank-0 special case needed; summarize is
+deterministic).
+
+Shard protocol: every process evaluates an equal-length slice of the
+image list (pad the last slice and mark padding with image_valid=False
+— the analog of DistributedSampler's wrap-around padding, deduplicated
+here by dropping invalid rows).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..parallel.collectives import host_allgather
+from .coco_eval import CocoEvaluator
+
+
+def pack_shard(image_ids, det: Dict, gt: Dict,
+               image_valid: Optional[np.ndarray] = None) -> Dict:
+    """Bundle one process's padded per-image arrays for the gather.
+
+    det: {'boxes' (B,D,4), 'scores' (B,D), 'labels' (B,D), 'valid' (B,D)}
+    gt:  {'boxes' (B,G,4), 'labels' (B,G), 'valid' (B,G)}
+    image_valid: (B,) False for wrap-around padding images.
+    """
+    b = len(image_ids)
+    if image_valid is None:
+        image_valid = np.ones((b,), bool)
+    return {
+        "image_ids": np.asarray(image_ids, np.int64),
+        "image_valid": np.asarray(image_valid, bool),
+        "det_boxes": np.asarray(det["boxes"], np.float32),
+        "det_scores": np.asarray(det["scores"], np.float32),
+        "det_labels": np.asarray(det["labels"], np.int64),
+        "det_valid": np.asarray(det["valid"], bool),
+        "gt_boxes": np.asarray(gt["boxes"], np.float32),
+        "gt_labels": np.asarray(gt["labels"], np.int64),
+        "gt_valid": np.asarray(gt["valid"], bool),
+    }
+
+
+def gather_and_evaluate(shard: Dict, num_classes: int,
+                        allgather: Callable = host_allgather,
+                        use_cpp: bool = True) -> Dict[str, float]:
+    """All-gather every process's shard and run the COCO metrics over
+    the union. Returns the 12-metric summary dict; identical on every
+    host. ``allgather`` is injectable so the multi-process path is
+    testable single-process (tests stack shards to fake a world)."""
+    gathered = {k: np.asarray(v) for k, v in allgather(shard).items()}
+    ev = CocoEvaluator(num_classes=num_classes, use_cpp=use_cpp)
+    seen = set()
+    n_proc = gathered["image_ids"].shape[0]
+    for p in range(n_proc):
+        for i in range(gathered["image_ids"].shape[1]):
+            if not gathered["image_valid"][p, i]:
+                continue
+            img_id = int(gathered["image_ids"][p, i])
+            if img_id in seen:        # wrap-around duplicate safety
+                continue
+            seen.add(img_id)
+            dv = gathered["det_valid"][p, i]
+            gv = gathered["gt_valid"][p, i]
+            ev.add_image(
+                img_id,
+                gt_boxes=gathered["gt_boxes"][p, i][gv],
+                gt_labels=gathered["gt_labels"][p, i][gv],
+                det_boxes=gathered["det_boxes"][p, i][dv],
+                det_scores=gathered["det_scores"][p, i][dv],
+                det_labels=gathered["det_labels"][p, i][dv])
+    return ev.summarize()

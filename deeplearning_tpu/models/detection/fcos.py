@@ -37,7 +37,10 @@ class ScaleExp(nn.Module):
     @nn.compact
     def __call__(self, x):
         s = self.param("scale", nn.initializers.ones, ())
-        return jnp.exp(x * s)
+        # clipped exponent (same guard as yolox decode_outputs): an
+        # unbounded exp overflows to inf early in training at high lr
+        # and poisons the GIoU loss with nan
+        return jnp.exp(jnp.clip(x * s, -10.0, 8.0))
 
 
 class FCOSHead(nn.Module):

@@ -1,3 +1,3 @@
-from . import lr_finder, optim, schedules, trainer  # noqa: F401
+from . import lr_finder, multiscale, optim, schedules, trainer  # noqa: F401
 from .state import TrainState  # noqa: F401
 from .steps import make_train_step, make_eval_step, shard_state  # noqa: F401

@@ -1,0 +1,98 @@
+"""Multi-scale detection training via bucketed static shapes.
+
+Reference behavior: yolov5 randomly rescales the batch to imgsz×[0.5,
+1.5] each iter with the size broadcast from rank 0 (detection/yolov5/
+train.py:357), and YOLOX's Exp.random_resize picks a size from
+[448..832]/32 every 10 iters (detection/YOLOX/yolox/exp/
+yolox_base.py:167, applied in trainer preprocess).
+
+TPU-native form: XLA compiles one executable per static input shape, so
+"random resize" becomes a FIXED bucket list — the jitted train step
+retraces once per bucket (compile cache holds all of them; steady state
+has zero recompiles), and the bucket choice is a counter-based pure
+function of (seed, step), so every host/process picks the same size
+with no broadcast collective (the rank-0 torch.distributed broadcast
+becomes unnecessary by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# YOLOX default buckets: [448..832] step 32 (yolox_base.py random_size
+# range (10, 20) × 32)
+YOLOX_SIZES: Tuple[int, ...] = tuple(range(448, 833, 32))
+
+
+class MultiScaleSchedule:
+    """Deterministic bucketed size schedule.
+
+    ``size_for_step(step)`` returns the training size for a global step:
+    constant within windows of ``change_every`` steps, pseudo-random
+    across windows, identical on every host for the same seed.
+    """
+
+    def __init__(self, sizes: Sequence[int] = YOLOX_SIZES,
+                 change_every: int = 10, seed: int = 0):
+        if not sizes:
+            raise ValueError("need at least one size bucket")
+        self.sizes = tuple(int(s) for s in sizes)
+        self.change_every = max(int(change_every), 1)
+        self.seed = seed
+
+    def size_for_step(self, step: int) -> int:
+        window = int(step) // self.change_every
+        idx = np.random.default_rng(
+            [self.seed, window]).integers(len(self.sizes))
+        return self.sizes[int(idx)]
+
+    def __iter__(self):
+        step = 0
+        while True:
+            yield self.size_for_step(step)
+            step += 1
+
+
+def resize_detection_batch(batch: Dict[str, jax.Array], size: int,
+                           method: str = "bilinear"
+                           ) -> Dict[str, jax.Array]:
+    """Resize a padded detection batch to (size, size), scaling the box
+    pixel coordinates by the same ratios (the target-rescale half of
+    yolox random_resize). No-op when already at the target size."""
+    imgs = batch["image"]
+    b, h, w, c = imgs.shape
+    if (h, w) == (size, size):
+        return batch
+    out = dict(batch)
+    out["image"] = jax.image.resize(
+        imgs, (b, size, size, c), method)
+    if "boxes" in batch:
+        sx, sy = size / w, size / h
+        out["boxes"] = batch["boxes"] * jnp.asarray(
+            [sx, sy, sx, sy], batch["boxes"].dtype)
+    return out
+
+
+def make_multiscale_step(step_fn, schedule: MultiScaleSchedule,
+                         resize=resize_detection_batch,
+                         start_step: int = 0):
+    """Wrap a jitted train step: each call resizes the host batch to the
+    scheduled bucket before invoking the step. ``step_fn`` retraces once
+    per bucket; steady-state runs entirely from the executable cache.
+
+    The step counter is host-side (seed with ``start_step`` when
+    resuming): reading ``state.step`` back from the device every iter
+    would force a D2H sync and serialize the async dispatch pipeline.
+    """
+    counter = {"n": int(start_step)}
+
+    def wrapped(state, batch, *rest):
+        size = schedule.size_for_step(counter["n"])
+        counter["n"] += 1
+        return step_fn(state, resize(batch, size), *rest)
+
+    return wrapped

@@ -1,0 +1,25 @@
+"""Detection CLI family dispatch: every advertised family trains a few
+steps and produces evaluator output (train_detection.py build_task)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.mark.parametrize("name,extra", [
+    ("yolox_nano", ["train.multiscale=true"]),
+    ("fcos_resnet18_fpn", []),
+    ("fasterrcnn_resnet18_fpn", []),
+])
+def test_family_trains_and_evaluates(name, extra, capsys):
+    from train_detection import main
+    rc = main(["model.name=" + name, "model.image_size=64",
+               "data.batch=2", "data.n_train=4", "train.steps=2"] + extra)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "'AP'" in out          # evaluator summary printed
+    assert "nan" not in out

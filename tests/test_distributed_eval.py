@@ -1,0 +1,104 @@
+"""Distributed COCO evaluation: sharded gather must reproduce the
+single-process metrics exactly (YOLOX coco_evaluator gather semantics,
+VERDICT item 9)."""
+
+import numpy as np
+import pytest
+
+from deeplearning_tpu.evaluation.coco_eval import CocoEvaluator
+from deeplearning_tpu.evaluation.distributed import (gather_and_evaluate,
+                                                     pack_shard)
+
+MAX_DET, MAX_GT = 6, 4
+NUM_CLASSES = 3
+
+
+def synth_image(rng):
+    n_gt = int(rng.integers(1, MAX_GT + 1))
+    n_det = int(rng.integers(0, MAX_DET + 1))
+    gt_boxes = np.zeros((MAX_GT, 4), np.float32)
+    gt_labels = np.zeros((MAX_GT,), np.int64)
+    gt_valid = np.zeros((MAX_GT,), bool)
+    for g in range(n_gt):
+        x0, y0 = rng.uniform(0, 80, 2)
+        w, h = rng.uniform(10, 40, 2)
+        gt_boxes[g] = (x0, y0, x0 + w, y0 + h)
+        gt_labels[g] = rng.integers(0, NUM_CLASSES)
+        gt_valid[g] = True
+    det_boxes = np.zeros((MAX_DET, 4), np.float32)
+    det_scores = np.zeros((MAX_DET,), np.float32)
+    det_labels = np.zeros((MAX_DET,), np.int64)
+    det_valid = np.zeros((MAX_DET,), bool)
+    for d in range(n_det):
+        if rng.random() < 0.6 and n_gt:          # near-hit of some gt
+            g = int(rng.integers(0, n_gt))
+            jitter = rng.uniform(-4, 4, 4).astype(np.float32)
+            det_boxes[d] = gt_boxes[g] + jitter
+            det_labels[d] = gt_labels[g]
+        else:                                     # random box
+            x0, y0 = rng.uniform(0, 80, 2)
+            w, h = rng.uniform(10, 40, 2)
+            det_boxes[d] = (x0, y0, x0 + w, y0 + h)
+            det_labels[d] = rng.integers(0, NUM_CLASSES)
+        det_scores[d] = rng.uniform(0.1, 1.0)
+        det_valid[d] = True
+    return dict(gt_boxes=gt_boxes, gt_labels=gt_labels, gt_valid=gt_valid,
+                det_boxes=det_boxes, det_scores=det_scores,
+                det_labels=det_labels, det_valid=det_valid)
+
+
+@pytest.mark.parametrize("n_images,n_proc", [(8, 2), (9, 4)])
+def test_sharded_gather_matches_single_process(n_images, n_proc):
+    rng = np.random.default_rng(0)
+    images = [synth_image(rng) for _ in range(n_images)]
+
+    # single-process baseline
+    ev = CocoEvaluator(num_classes=NUM_CLASSES, use_cpp=False)
+    for i, im in enumerate(images):
+        ev.add_image(i,
+                     gt_boxes=im["gt_boxes"][im["gt_valid"]],
+                     gt_labels=im["gt_labels"][im["gt_valid"]],
+                     det_boxes=im["det_boxes"][im["det_valid"]],
+                     det_scores=im["det_scores"][im["det_valid"]],
+                     det_labels=im["det_labels"][im["det_valid"]])
+    baseline = ev.summarize()
+
+    # shard over n_proc fake processes with wrap-around padding (equal
+    # per-process length, like DistributedSampler)
+    per = -(-n_images // n_proc)
+    shards = []
+    for p in range(n_proc):
+        ids, valid, imgs = [], [], []
+        for j in range(per):
+            idx = p * per + j
+            ids.append(idx % n_images)
+            valid.append(idx < n_images)
+            imgs.append(images[idx % n_images])
+        det = {k: np.stack([im[f"det_{k}"] for im in imgs])
+               for k in ("boxes", "scores", "labels", "valid")}
+        gt = {k: np.stack([im[f"gt_{k}"] for im in imgs])
+              for k in ("boxes", "labels", "valid")}
+        shards.append(pack_shard(ids, det, gt, np.asarray(valid)))
+
+    def fake_allgather(local):
+        # what process_allgather returns: leading process axis
+        return {k: np.stack([s[k] for s in shards]) for k in local}
+
+    result = gather_and_evaluate(shards[0], NUM_CLASSES,
+                                 allgather=fake_allgather, use_cpp=False)
+    for k, v in baseline.items():
+        assert result[k] == pytest.approx(v, abs=1e-9), k
+
+
+def test_single_process_allgather_path():
+    """With jax.process_count()==1, the real host_allgather just adds a
+    leading axis — gather_and_evaluate must work end to end."""
+    rng = np.random.default_rng(1)
+    images = [synth_image(rng) for _ in range(4)]
+    det = {k: np.stack([im[f"det_{k}"] for im in images])
+           for k in ("boxes", "scores", "labels", "valid")}
+    gt = {k: np.stack([im[f"gt_{k}"] for im in images])
+          for k in ("boxes", "labels", "valid")}
+    shard = pack_shard(list(range(4)), det, gt)
+    result = gather_and_evaluate(shard, NUM_CLASSES, use_cpp=False)
+    assert 0.0 <= result["AP"] <= 1.0

@@ -1,0 +1,116 @@
+"""Multi-scale detection training via bucketed static shapes
+(yolov5 train.py:357 broadcast resize / YOLOX yolox_base.py:167
+random_resize, reformulated for XLA's one-executable-per-shape model)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_tpu.train.multiscale import (MultiScaleSchedule,
+                                               YOLOX_SIZES,
+                                               make_multiscale_step,
+                                               resize_detection_batch)
+
+
+class TestSchedule:
+    def test_deterministic_and_windowed(self):
+        s1 = MultiScaleSchedule(seed=7, change_every=10)
+        s2 = MultiScaleSchedule(seed=7, change_every=10)
+        sizes1 = [s1.size_for_step(i) for i in range(50)]
+        sizes2 = [s2.size_for_step(i) for i in range(50)]
+        assert sizes1 == sizes2                 # same on every "host"
+        for i in range(50):
+            assert sizes1[i] == sizes1[(i // 10) * 10]   # stable in window
+        assert len(set(sizes1)) > 1             # actually varies
+        assert set(sizes1) <= set(YOLOX_SIZES)
+
+    def test_custom_buckets(self):
+        s = MultiScaleSchedule(sizes=(64, 96), change_every=1, seed=0)
+        assert set(s.size_for_step(i) for i in range(20)) == {64, 96}
+
+
+class TestResize:
+    def test_boxes_scaled_with_image(self):
+        batch = {
+            "image": jnp.ones((2, 64, 64, 3)),
+            "boxes": jnp.asarray([[[8.0, 16.0, 32.0, 48.0]] * 1] * 2),
+            "labels": jnp.zeros((2, 1), jnp.int32),
+        }
+        out = resize_detection_batch(batch, 96)
+        assert out["image"].shape == (2, 96, 96, 3)
+        np.testing.assert_allclose(
+            np.asarray(out["boxes"][0, 0]), [12.0, 24.0, 48.0, 72.0])
+        # no-op path returns the batch unchanged
+        same = resize_detection_batch(batch, 64)
+        assert same["image"] is batch["image"]
+
+
+class TestYoloxMultiScaleStep:
+    def test_two_buckets_train_and_retrace_once_each(self):
+        """The YOLOX step runs at two bucket sizes: the grid is
+        recomputed per trace from the static batch shape, losses stay
+        finite, and each bucket compiles exactly once."""
+        import optax
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.models.detection.yolox import (yolox_grid,
+                                                             yolox_loss)
+
+        model = MODELS.build("yolox_nano", num_classes=3,
+                             dtype=jnp.float32)
+        size0 = 64
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, size0, size0, 3)),
+                               train=False)
+        params, stats = variables["params"], variables["batch_stats"]
+        tx = optax.sgd(1e-3)
+        opt_state = tx.init(params)
+        traces = {"n": 0}
+
+        @jax.jit
+        def step(params, opt_state, stats, batch):
+            traces["n"] += 1
+            hw = batch["image"].shape[1:3]
+            centers, strides = yolox_grid(hw)
+            centers, strides = jnp.asarray(centers), jnp.asarray(strides)
+
+            def loss_fn(p):
+                out, mut = model.apply(
+                    {"params": p, "batch_stats": stats}, batch["image"],
+                    train=True, mutable=["batch_stats"])
+                l = yolox_loss(out, centers, strides, batch["boxes"],
+                               batch["labels"], batch["valid"],
+                               num_classes=3)
+                return (l["iou_loss"] + l["obj_loss"] + l["cls_loss"],
+                        mut)
+
+            (total, mut), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    mut["batch_stats"], total)
+
+        class State:
+            step = 0
+
+        schedule = MultiScaleSchedule(sizes=(64, 96), change_every=1,
+                                      seed=3)
+        wrapped = make_multiscale_step(
+            lambda st, b: step(params, opt_state, stats, b), schedule)
+
+        rng = np.random.default_rng(0)
+        seen = set()
+        st = State()
+        for i in range(4):
+            st.step = i
+            batch = {
+                "image": jnp.asarray(rng.normal(
+                    0, 1, (2, size0, size0, 3)), jnp.float32),
+                "boxes": jnp.asarray([[[4.0, 4.0, 40.0, 40.0]]] * 2),
+                "labels": jnp.zeros((2, 1), jnp.int32),
+                "valid": jnp.ones((2, 1), bool),
+            }
+            *_, total = wrapped(st, batch)
+            assert np.isfinite(float(total))
+            seen.add(schedule.size_for_step(i))
+        assert seen == {64, 96}
+        assert traces["n"] == 2          # one trace per bucket, cached

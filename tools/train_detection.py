@@ -1,15 +1,20 @@
 #!/usr/bin/env python
-"""Detection training CLI: RetinaNet end-to-end with COCO evaluation.
+"""Detection training CLI: RetinaNet / YOLOX / FCOS with COCO evaluation.
 
   python tools/train_detection.py [--cfg FILE] [key value ...]
   DLTPU_PLATFORM=cpu python tools/train_detection.py train.steps=60
+  ... model.name=yolox_s train.multiscale=true   # bucketed random_resize
 
 The detection successor of the per-project train entries
-(detection/RetinaNet/train.py, fasterRcnn/train_resnet50_fpn.py): builds
-the detector, trains on padded fixed-shape box batches (synthetic
-colored-box data by default; npz with images/boxes/labels/valid
-otherwise), then runs fixed-shape postprocess + the COCO evaluator with
-the native C++ matching path and prints the 12-metric summary.
+(detection/RetinaNet/train.py, fasterRcnn/train_resnet50_fpn.py,
+YOLOX/tools/train.py): builds the detector, dispatches the family's
+loss/postprocess (anchor-based focal, SimOTA, or FCOS targets), trains
+on padded fixed-shape box batches (synthetic colored-box data by
+default; npz with images/boxes/labels/valid otherwise), then runs
+fixed-shape postprocess + the COCO evaluator with the native C++
+matching path and prints the 12-metric summary. ``train.multiscale``
+enables the bucketed-static-shape random_resize schedule
+(train/multiscale.py).
 """
 
 from __future__ import annotations
@@ -53,6 +58,10 @@ class DetTrainCfg:
     clip_grad_norm: float = 1.0
     seed: int = 0
     eval_score_thresh: float = 0.3
+    multiscale: bool = False          # bucketed random_resize schedule
+    multiscale_min: float = 0.75      # bucket range as ratios of image_size
+    multiscale_max: float = 1.25
+    multiscale_every: int = 10        # steps between size changes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,14 +93,140 @@ def synthetic_boxes(n: int, size: int, num_classes: int, max_gt: int,
     return images, boxes, labels, valid
 
 
+def build_task(model, name: str, num_classes: int, score_thresh: float):
+    """Family dispatch. Returns
+    (loss_fn(params, stats, batch, rng) -> (total_loss, new_stats),
+     predict_fn(params, stats, images) -> padded det dict).
+    The image size is read from the traced batch shape, so grids/anchors
+    are rebuilt per multi-scale bucket."""
+
+    def apply_train(params, stats, images, **kw):
+        out, mut = model.apply({"params": params, "batch_stats": stats},
+                               images, train=True,
+                               mutable=["batch_stats"], **kw)
+        return out, mut.get("batch_stats", stats)
+
+    def apply_eval(params, stats, images, **kw):
+        return model.apply({"params": params, "batch_stats": stats},
+                           images, train=False, **kw)
+
+    if name.startswith("retinanet"):
+        from deeplearning_tpu.models.detection.retinanet import (
+            retinanet_anchors, retinanet_loss, retinanet_postprocess)
+
+        def loss_fn(params, stats, batch, rng):
+            hw = batch["image"].shape[1:3]
+            out, new_stats = apply_train(params, stats, batch["image"])
+            l = retinanet_loss(out, jnp.asarray(retinanet_anchors(hw)),
+                               batch["boxes"], batch["labels"],
+                               batch["valid"])
+            return l["cls_loss"] + l["reg_loss"], new_stats
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            out = apply_eval(params, stats, images)
+            return retinanet_postprocess(
+                out, jnp.asarray(retinanet_anchors(hw)), hw, max_det=10,
+                score_thresh=score_thresh)
+        return loss_fn, predict_fn
+
+    if name.startswith("yolox"):
+        from deeplearning_tpu.models.detection.yolox import (
+            yolox_grid, yolox_loss, yolox_postprocess)
+
+        def loss_fn(params, stats, batch, rng):
+            hw = batch["image"].shape[1:3]
+            centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
+            out, new_stats = apply_train(params, stats, batch["image"])
+            l = yolox_loss(out, centers, strides, batch["boxes"],
+                           batch["labels"], batch["valid"],
+                           num_classes=num_classes)
+            return (l["iou_loss"] + l["obj_loss"] + l["cls_loss"],
+                    new_stats)
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
+            out = apply_eval(params, stats, images)
+            return yolox_postprocess(out, centers, strides, max_det=10,
+                                     score_thresh=score_thresh)
+        return loss_fn, predict_fn
+
+    if name.startswith("fcos"):
+        from deeplearning_tpu.models.detection.fcos import (
+            fcos_locations, fcos_loss, fcos_postprocess, fcos_targets)
+
+        def loss_fn(params, stats, batch, rng):
+            hw = batch["image"].shape[1:3]
+            locs, lvl = (jnp.asarray(a) for a in fcos_locations(hw))
+            out, new_stats = apply_train(params, stats, batch["image"])
+            tgt = fcos_targets(locs, lvl, batch["boxes"], batch["labels"],
+                               batch["valid"])
+            l = fcos_loss(out, tgt)
+            return (l["cls_loss"] + l["reg_loss"] + l["ctr_loss"],
+                    new_stats)
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            locs, _ = fcos_locations(hw)
+            out = apply_eval(params, stats, images)
+            return fcos_postprocess(out, jnp.asarray(locs), hw,
+                                    max_det=10, score_thresh=score_thresh)
+        return loss_fn, predict_fn
+
+    if name.startswith("fasterrcnn"):
+        # two-stage: RPN loss on the first apply, proposals sampled
+        # under stop-gradient semantics, ROI-head loss on the second
+        # apply (train_resnet50_fpn.py flow). The model's class space is
+        # num_classes+1 with 0 = background, so gt labels shift +1 here
+        # and detections shift -1 back in predict.
+        from deeplearning_tpu.models.detection.faster_rcnn import (
+            fasterrcnn_anchors, fasterrcnn_postprocess,
+            generate_proposals, roi_head_loss, rpn_loss, sample_rois)
+
+        def loss_fn(params, stats, batch, rng):
+            hw = batch["image"].shape[1:3]
+            anchors = jnp.asarray(fasterrcnn_anchors(hw))
+            labels1 = jnp.where(batch["valid"], batch["labels"] + 1, 0)
+            out, stats1 = apply_train(params, stats, batch["image"])
+            r = rpn_loss(out, anchors, batch["boxes"], batch["valid"],
+                         rng)
+            props, pvalid = generate_proposals(out, anchors, hw)
+            samples = sample_rois(
+                jax.lax.stop_gradient(props), pvalid, batch["boxes"],
+                labels1, batch["valid"], rng)
+            out2, stats2 = apply_train(params, stats1, batch["image"],
+                                       proposals=samples["rois"])
+            h = roi_head_loss(out2["roi_scores"], out2["roi_deltas"],
+                              samples)
+            return (r["rpn_obj_loss"] + r["rpn_reg_loss"]
+                    + h["roi_cls_loss"] + h["roi_reg_loss"], stats2)
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            anchors = jnp.asarray(fasterrcnn_anchors(hw))
+            out = apply_eval(params, stats, images)
+            props, pvalid = generate_proposals(out, anchors, hw)
+            out2 = apply_eval(params, stats, images, proposals=props)
+            det = fasterrcnn_postprocess(
+                out2["roi_scores"], out2["roi_deltas"], props, hw,
+                prop_valid=pvalid, score_thresh=score_thresh, max_det=10)
+            det["labels"] = det["labels"] - 1      # back to 0-based fg
+            return det
+        return loss_fn, predict_fn
+
+    raise ValueError(f"no detection task for model {name!r} "
+                     "(expected retinanet*/fasterrcnn*/yolox*/fcos*)")
+
+
 def main(argv=None) -> int:
     import optax
 
     from deeplearning_tpu.core.config import config_cli
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.evaluation.coco_eval import CocoEvaluator
-    from deeplearning_tpu.models.detection.retinanet import (
-        retinanet_anchors, retinanet_loss, retinanet_postprocess)
+    from deeplearning_tpu.train.multiscale import (MultiScaleSchedule,
+                                                   resize_detection_batch)
 
     cfg = config_cli(DetConfig(), argv, description=__doc__)
     size = cfg.model.image_size
@@ -104,48 +239,57 @@ def main(argv=None) -> int:
             cfg.data.n_train, size, cfg.model.num_classes,
             cfg.data.max_gt, cfg.train.seed)
 
-    model = MODELS.build(cfg.model.name, num_classes=cfg.model.num_classes)
+    model_classes = cfg.model.num_classes + (
+        1 if cfg.model.name.startswith("fasterrcnn") else 0)  # +background
+    model = MODELS.build(cfg.model.name, num_classes=model_classes)
+    loss_fn_task, predict_fn = build_task(model, cfg.model.name,
+                                          cfg.model.num_classes,
+                                          cfg.train.eval_score_thresh)
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
-    anchors = jnp.asarray(retinanet_anchors((size, size)))
     tx = optax.chain(optax.clip_by_global_norm(cfg.train.clip_grad_norm),
                      optax.adam(cfg.train.lr))
     opt_state = tx.init(params)
 
+    schedule = None
+    if cfg.train.multiscale:
+        lo = int(size * cfg.train.multiscale_min) // 32 * 32
+        hi = int(size * cfg.train.multiscale_max) // 32 * 32
+        sizes = tuple(range(max(lo, 32), hi + 1, 32)) or (size,)
+        schedule = MultiScaleSchedule(sizes=sizes,
+                                      change_every=cfg.train.multiscale_every,
+                                      seed=cfg.train.seed)
+
     @jax.jit
-    def step(params, opt_state, stats, batch):
+    def step(params, opt_state, stats, batch, key):
         def loss_fn(p):
-            out, mut = model.apply(
-                {"params": p, "batch_stats": stats}, batch["image"],
-                train=True, mutable=["batch_stats"])
-            l = retinanet_loss(out, anchors, batch["boxes"],
-                               batch["labels"], batch["valid"])
-            return l["cls_loss"] + l["reg_loss"], mut
-        (total, mut), grads = jax.value_and_grad(
+            return loss_fn_task(p, stats, batch, key)
+        (total, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state,
-                mut["batch_stats"], total)
+                new_stats, total)
 
     n = len(images)
     rng = np.random.default_rng(cfg.train.seed)
+    key = jax.random.key(cfg.train.seed)
     for it in range(cfg.train.steps):
         idx = rng.choice(n, cfg.data.batch, replace=False)
         batch = {"image": jnp.asarray(images[idx]),
                  "boxes": jnp.asarray(boxes[idx]),
                  "labels": jnp.asarray(labels[idx]),
                  "valid": jnp.asarray(valid[idx])}
-        params, opt_state, stats, total = step(params, opt_state, stats,
-                                               batch)
+        if schedule is not None:
+            batch = resize_detection_batch(batch,
+                                           schedule.size_for_step(it))
+        params, opt_state, stats, total = step(
+            params, opt_state, stats, batch, jax.random.fold_in(key, it))
         if it % max(cfg.train.steps // 5, 1) == 0:
             print(f"step {it}: loss={float(total):.4f}")
 
     # ---- evaluate on the training set (smoke metric)
-    out = model.apply({"params": params, "batch_stats": stats},
-                      jnp.asarray(images), train=False)
-    det = retinanet_postprocess(out, anchors, (size, size), max_det=10,
-                                score_thresh=cfg.train.eval_score_thresh)
+    det = predict_fn(params, stats, jnp.asarray(images))
     ev = CocoEvaluator(num_classes=cfg.model.num_classes)
     for i in range(n):
         keep = np.asarray(det["valid"][i])
