@@ -1,0 +1,99 @@
+"""Real-image input pipeline builder.
+
+Capability surface of classification/swin_transformer/dataLoader/build.py
+(:38 build_loader — ImageFolder/zip dataset + DistributedSampler + torch
+DataLoader(num_workers, pin_memory) + mixup) and its ~16 per-project
+copies (classification/mnist/dataLoader/dataSet.py etc.), reshaped for
+TPU hosts:
+
+- each host scans the folder once and loads ONLY its slice of every
+  global batch (DataLoader host sharding — the DistributedSampler
+  successor);
+- JPEG decode + augmentation run on a thread pool (``num_workers``)
+  overlapped with step compute via ``prefetch_to_device`` — the
+  pin_memory/CUDA-stream prefetch analog without streams;
+- batches are fixed-shape so the jitted step never retraces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .datasets import folder_source, read_split_data, write_class_indices
+from .loader import DataLoader, prefetch_to_device
+from .transforms import eval_image_transform, train_image_transform
+
+
+@dataclasses.dataclass(frozen=True)
+class LoaderConfig:
+    """Knobs of build_loader (dataLoader/build.py:38) that survive the
+    torch→TPU translation."""
+    global_batch: int = 128
+    image_size: int = 224
+    val_rate: float = 0.2
+    num_workers: int = 8
+    lookahead: int = 4
+    seed: int = 0
+    prefetch: int = 2
+
+
+def build_classification_loaders(
+        root: str, cfg: LoaderConfig = LoaderConfig(), *,
+        mesh=None, class_indices_path: Optional[str] = None,
+        train_transform: Optional[Callable] = None,
+        eval_transform: Optional[Callable] = None,
+) -> Tuple[DataLoader, DataLoader, Dict[str, int]]:
+    """(train_loader, val_loader, class_to_idx) from an ImageFolder root.
+
+    Decode/augment happen per sample inside folder_source's fetch, so the
+    DataLoader's worker pool parallelizes the full decode+augment path.
+    """
+    split = read_split_data(root, val_rate=cfg.val_rate, seed=cfg.seed)
+    if class_indices_path:
+        write_class_indices(split["class_to_idx"], class_indices_path)
+    size = (cfg.image_size, cfg.image_size)
+    tt = train_transform or train_image_transform(size, seed=cfg.seed)
+    et = eval_transform or eval_image_transform(size)
+    train = DataLoader(
+        folder_source(split["train_paths"], split["train_labels"], tt),
+        cfg.global_batch, shuffle=True, seed=cfg.seed, mesh=mesh,
+        num_workers=cfg.num_workers, lookahead=cfg.lookahead)
+    # clamp the val batch so a split smaller than global_batch still
+    # yields batches (drop-last would otherwise drop the whole set);
+    # keep it divisible by process count
+    n_proc = jax.process_count()
+    val_batch = min(cfg.global_batch,
+                    max(len(split["val_paths"]) // n_proc, 1) * n_proc)
+    val = DataLoader(
+        folder_source(split["val_paths"], split["val_labels"], et),
+        val_batch, shuffle=False, seed=cfg.seed, mesh=mesh,
+        num_workers=cfg.num_workers, lookahead=cfg.lookahead)
+    return train, val, split["class_to_idx"]
+
+
+def device_iterator(loader: DataLoader, cfg: LoaderConfig, sharding=None):
+    """Epoch iterator with host→HBM prefetch overlapped with compute."""
+    return prefetch_to_device(iter(loader), size=cfg.prefetch,
+                              sharding=sharding)
+
+
+def measure_throughput(loader: DataLoader, n_batches: int = 30,
+                       warmup: int = 2) -> float:
+    """Host-pipeline images/sec (decode+augment+batch, no device work).
+    The proof the feed outruns the step rate (VERDICT: ≥ the 960 img/s
+    ViT-B step rate means data is not the MFU ceiling)."""
+    import time
+    it = iter(loader)
+    n = 0
+    for _ in range(warmup):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        batch = next(it)
+        n += len(next(iter(batch.values())))
+    dt = time.perf_counter() - t0
+    return n / dt

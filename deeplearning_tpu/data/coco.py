@@ -1,0 +1,80 @@
+"""COCO-format detection dataset: real JPEGs + instances.json.
+
+Capability surface of detection/YOLOX/yolox/data/datasets/coco.py
+(COCODataset: json parse → per-image (img, padded boxes) with decode on
+access) and fasterRcnn's VOC/COCO dataset classes, reshaped for fixed
+TPU batches: every sample is resize-with-pad to a static size with boxes
+rescaled, gt padded to ``max_gt`` with a valid mask, so the jitted step
+never retraces. Decode runs per-sample inside the loader's thread pool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .datasets import load_image
+from .label_convert import coco_to_records
+from .loader import MapSource
+from .transforms import random_flip_lr, resize_with_pad, thread_rng
+
+
+def load_coco_json(json_path: str) -> Tuple[Sequence[Dict], Sequence[str]]:
+    """(records, class_names) from an instances.json. Records carry
+    filename + absolute xyxy boxes + class names (label_convert schema)."""
+    with open(json_path) as f:
+        coco = json.load(f)
+    class_names = [c["name"] for c in
+                   sorted(coco["categories"], key=lambda c: c["id"])]
+    return coco_to_records(coco), class_names
+
+
+def coco_detection_source(json_path: Optional[str] = None,
+                          images_dir: Optional[str] = None,
+                          *, image_size: int = 256, max_gt: int = 16,
+                          augment: bool = False, seed: int = 0,
+                          records: Optional[Sequence[Dict]] = None,
+                          class_names: Optional[Sequence[str]] = None,
+                          ) -> Tuple[MapSource, Sequence[str]]:
+    """MapSource of fixed-shape samples {image, boxes, labels, valid}
+    decoded lazily from disk. ``augment`` adds horizontal flip (the
+    YOLOX/fasterRcnn baseline transform; mosaic/mixup compose on top via
+    data.mixup utilities). Pass pre-parsed ``records``/``class_names``
+    (from load_coco_json) to build several sources — e.g. augmented
+    train + raw val — without re-parsing the json."""
+    if records is None:
+        if json_path is None:
+            raise ValueError("need json_path or records")
+        records, class_names = load_coco_json(json_path)
+    if images_dir is None:
+        if json_path is None:
+            raise ValueError("need images_dir when passing records")
+        images_dir = os.path.join(os.path.dirname(json_path), "images")
+    name_to_id = {n: i for i, n in enumerate(class_names)}
+    out_hw = (image_size, image_size)
+
+    import threading
+    local = threading.local()
+
+    def fetch(i: int) -> Dict[str, np.ndarray]:
+        rec = records[i]
+        img = load_image(os.path.join(images_dir, rec["filename"]))
+        img, _, boxes = resize_with_pad(img, out_hw, rec["boxes"])
+        if augment:
+            img, boxes = random_flip_lr(img, thread_rng(local, seed),
+                                        boxes)
+        pboxes = np.zeros((max_gt, 4), np.float32)
+        plabels = np.zeros((max_gt,), np.int64)
+        pvalid = np.zeros((max_gt,), bool)
+        take = min(len(boxes), max_gt)
+        if take:
+            pboxes[:take] = boxes[:take]
+            plabels[:take] = [name_to_id[x] for x in rec["names"][:take]]
+            pvalid[:take] = True
+        return {"image": np.asarray(img, np.float32) / 255.0,
+                "boxes": pboxes, "labels": plabels, "valid": pvalid}
+
+    return MapSource(len(records), fetch), class_names

@@ -57,6 +57,9 @@ def read_split_data(root: str, val_rate: float = 0.2, seed: int = 0
 def write_class_indices(class_to_idx: Dict[str, int], path: str) -> None:
     """class_indices.json (index -> name) for predict CLIs."""
     inv = {str(v): k for k, v in class_to_idx.items()}
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with open(path, "w") as f:
         json.dump(inv, f, indent=2)
 

@@ -81,7 +81,8 @@ class DataLoader:
     def __init__(self, source, global_batch: int, *, shuffle: bool = True,
                  seed: int = 0, mesh: Optional[Mesh] = None,
                  transform: Optional[Callable[[Dict], Dict]] = None,
-                 infinite: bool = False):
+                 infinite: bool = False, num_workers: int = 0,
+                 lookahead: int = 4):
         self.source = source
         self.global_batch = global_batch
         self.shuffle = shuffle
@@ -90,6 +91,9 @@ class DataLoader:
         self.transform = transform
         self.infinite = infinite
         self.epoch = 0
+        self.num_workers = num_workers
+        self.lookahead = max(lookahead, 1)
+        self._pool = None
         n_proc = jax.process_count()
         if global_batch % n_proc:
             raise ValueError(f"global_batch {global_batch} not divisible by "
@@ -102,7 +106,7 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
-    def _epoch_iter(self, epoch: int) -> Iterator[Dict[str, Any]]:
+    def _local_indices(self, epoch: int) -> Iterator[np.ndarray]:
         idx = epoch_indices(len(self.source), shuffle=self.shuffle,
                             seed=self.seed, epoch=epoch,
                             drop_last_to=self.global_batch)
@@ -110,14 +114,51 @@ class DataLoader:
         p = jax.process_index()
         for start in range(0, len(idx), self.global_batch):
             gbatch = idx[start:start + self.global_batch]
-            local = gbatch[p * self.host_batch:(p + 1) * self.host_batch]
-            batch = self.source[local]
-            if self.transform:
-                batch = self.transform(batch)
-            if self.mesh is not None:
-                batch = {k: make_global_array(np.asarray(v), self.mesh)
-                         for k, v in batch.items()}
-            yield batch
+            yield gbatch[p * self.host_batch:(p + 1) * self.host_batch]
+
+    def _finalize(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        if self.transform:
+            batch = self.transform(batch)
+        if self.mesh is not None:
+            batch = {k: make_global_array(np.asarray(v), self.mesh)
+                     for k, v in batch.items()}
+        return batch
+
+    def _epoch_iter(self, epoch: int) -> Iterator[Dict[str, Any]]:
+        if self.num_workers:
+            yield from self._epoch_iter_parallel(epoch)
+            return
+        for local in self._local_indices(epoch):
+            yield self._finalize(self.source[local])
+
+    def _epoch_iter_parallel(self, epoch: int) -> Iterator[Dict[str, Any]]:
+        """num_workers>0: decode samples on a thread pool (the DataLoader
+        num_workers analog — PIL/cv2 JPEG decode releases the GIL), keeping
+        ``lookahead`` batches of per-sample futures in flight so decode
+        overlaps step compute."""
+        if self._pool is None:
+            import concurrent.futures
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.num_workers)
+        fetch = lambda i: self.source[int(i)]
+        pending: collections.deque = collections.deque()
+        it = self._local_indices(epoch)
+        try:
+            for local in itertools.islice(it, self.lookahead):
+                pending.append([self._pool.submit(fetch, i) for i in local])
+            while pending:
+                futs = pending.popleft()
+                samples = [f.result() for f in futs]
+                batch = {k: np.stack([s[k] for s in samples])
+                         for k in samples[0]}
+                yield self._finalize(batch)
+                for local in itertools.islice(it, 1):
+                    pending.append([self._pool.submit(fetch, i)
+                                    for i in local])
+        finally:
+            for futs in pending:
+                for f in futs:
+                    f.cancel()
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
         if not self.infinite:

@@ -10,6 +10,7 @@ output is FIXED size so the jitted model never retraces), color jitter
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -136,14 +137,50 @@ def classification_train_transform(out_hw=(224, 224), seed: int = 0):
     return fn
 
 
-def classification_eval_transform(out_hw=(224, 224), crop_frac=0.875):
-    """Batch-level resize + center-crop + normalize closure."""
-    def one(img: np.ndarray) -> np.ndarray:
+_THREAD_SEED = itertools.count()
+
+
+def thread_rng(local, seed: int) -> np.random.Generator:
+    """Per-thread Generator for transforms running inside a worker pool
+    (numpy Generators are not thread-safe). Each thread draws a unique
+    counter value, so streams never collide — masked thread idents do
+    (glibc reuses low address bits across pool threads)."""
+    rng = getattr(local, "rng", None)
+    if rng is None:
+        rng = local.rng = np.random.default_rng(
+            (seed, next(_THREAD_SEED)))
+    return rng
+
+
+def train_image_transform(out_hw=(224, 224), seed: int = 0):
+    """Per-IMAGE augment closure for folder_source(transform=...) — runs
+    inside the loader's decode worker pool."""
+    import threading
+    local = threading.local()
+
+    def fn(img: np.ndarray) -> np.ndarray:
+        rng = thread_rng(local, seed)
+        img = random_resized_crop(img, rng, out_hw)
+        img = random_flip_lr(img, rng)
+        img = color_jitter(img, rng)
+        return normalize(img)
+    return fn
+
+
+def eval_image_transform(out_hw=(224, 224), crop_frac=0.875):
+    """Per-IMAGE resize + center-crop + normalize closure."""
+    def fn(img: np.ndarray) -> np.ndarray:
         rh, rw = int(out_hw[0] / crop_frac), int(out_hw[1] / crop_frac)
         img = resize_bilinear(img, (rh, rw))
         y0 = (rh - out_hw[0]) // 2
         x0 = (rw - out_hw[1]) // 2
         return normalize(img[y0:y0 + out_hw[0], x0:x0 + out_hw[1]])
+    return fn
+
+
+def classification_eval_transform(out_hw=(224, 224), crop_frac=0.875):
+    """Batch-level wrapper over eval_image_transform."""
+    one = eval_image_transform(out_hw, crop_frac)
 
     def fn(batch: Dict) -> Dict:
         return {**batch, "image": np.stack([one(i)

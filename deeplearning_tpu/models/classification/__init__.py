@@ -1,2 +1,2 @@
 from . import (cnns, convnext, lenet, mobile, repvgg, resnet, swin,  # noqa: F401
-               transfg, vit)  # import registers factories
+               transfg, vit, zoo_extra)  # import registers factories

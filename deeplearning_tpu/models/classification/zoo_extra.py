@@ -1,0 +1,612 @@
+"""Happy-Whale modelZoo backbones: DPN, InceptionV4, Xception, NASNet-A,
+PolyNet, SENet-154.
+
+Capability surface of metric_learning/Happy-Whale/retrieval/models/
+modelZoo/{dpn.py, inceptionV4.py, nasnet.py, ployNet.py, senet.py,
+xception.py} — the alternative retrieval backbones of the Happy-Whale
+pipeline. Rebuilt as idiomatic Flax (NHWC, bf16 compute, BatchNorm with
+train flag); all are MXU-friendly: static shapes, convs ≥1x1, channel
+counts multiples of 8.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...core.registry import MODELS
+from .resnet import SEModule
+
+
+class ConvBN(nn.Module):
+    """conv → BN [→ relu], the building unit every zoo backbone shares."""
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    groups: int = 1
+    relu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, feature_group_count=self.groups,
+                    use_bias=False, dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn")(x)
+        return nn.relu(x) if self.relu else x
+
+
+class SepConvBN(nn.Module):
+    """Depthwise 3x3/5x5/7x7 + pointwise, each BN'd (Xception/NASNet
+    separable unit)."""
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        x = nn.Conv(c, self.kernel, strides=self.strides, padding="SAME",
+                    feature_group_count=c, use_bias=False,
+                    dtype=self.dtype, name="dw")(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="pw")(x)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                            dtype=self.dtype, name="bn")(x)
+
+
+def _pool(x, kind: str, window=(3, 3), strides=(1, 1)):
+    if kind == "max":
+        return nn.max_pool(x, window, strides=strides, padding="SAME")
+    return nn.avg_pool(x, window, strides=strides, padding="SAME",
+                       count_include_pad=False)
+
+
+# ---------------------------------------------------------------- Xception
+
+class XceptionBlock(nn.Module):
+    """relu→sepconv ×reps with residual 1x1 projection (xception.py Block)."""
+    features: int
+    reps: int
+    stride: int = 1
+    grow_first: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        res = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            res = ConvBN(self.features, (1, 1), (self.stride,) * 2,
+                         relu=False, dtype=self.dtype, name="skip")(
+                res, train)
+        y = x
+        feats = x.shape[-1]
+        for i in range(self.reps):
+            if self.grow_first or i > 0:
+                feats = self.features
+            y = nn.relu(y)
+            y = SepConvBN(feats, dtype=self.dtype, name=f"sep{i}")(y, train)
+        if self.stride != 1:
+            y = nn.max_pool(y, (3, 3), strides=(self.stride,) * 2,
+                            padding="SAME")
+        return y + res
+
+
+class Xception(nn.Module):
+    """Entry/middle/exit flows (xception.py:1-194)."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvBN(32, (3, 3), (2, 2), dtype=self.dtype, name="stem1")(
+            x, train)
+        x = ConvBN(64, (3, 3), dtype=self.dtype, name="stem2")(x, train)
+        for i, (f, s) in enumerate([(128, 2), (256, 2), (728, 2)]):
+            x = XceptionBlock(f, 2, s, dtype=self.dtype,
+                              name=f"entry{i}")(x, train)
+        for i in range(8):
+            x = XceptionBlock(728, 3, 1, dtype=self.dtype,
+                              name=f"mid{i}")(x, train)
+        x = XceptionBlock(1024, 2, 2, grow_first=False, dtype=self.dtype,
+                          name="exit0")(x, train)
+        x = nn.relu(SepConvBN(1536, dtype=self.dtype, name="exit1")(
+            x, train))
+        x = nn.relu(SepConvBN(2048, dtype=self.dtype, name="exit2")(
+            x, train))
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# ------------------------------------------------------------- InceptionV4
+
+class InceptionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b0 = cb(96, (1, 1), name="b0")(x, train)
+        b1 = cb(96, (3, 3), name="b1b")(
+            cb(64, (1, 1), name="b1a")(x, train), train)
+        b2 = cb(96, (3, 3), name="b2c")(
+            cb(96, (3, 3), name="b2b")(
+                cb(64, (1, 1), name="b2a")(x, train), train), train)
+        b3 = cb(96, (1, 1), name="b3")(_pool(x, "avg"), train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b0 = cb(384, (1, 1), name="b0")(x, train)
+        b1 = cb(256, (7, 1), name="b1c")(
+            cb(224, (1, 7), name="b1b")(
+                cb(192, (1, 1), name="b1a")(x, train), train), train)
+        b2 = x
+        for i, (f, k) in enumerate([(192, (1, 1)), (192, (7, 1)),
+                                    (224, (1, 7)), (224, (7, 1)),
+                                    (256, (1, 7))]):
+            b2 = cb(f, k, name=f"b2{i}")(b2, train)
+        b3 = cb(128, (1, 1), name="b3")(_pool(x, "avg"), train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b0 = cb(256, (1, 1), name="b0")(x, train)
+        b1 = cb(384, (1, 1), name="b1a")(x, train)
+        b1 = jnp.concatenate([cb(256, (1, 3), name="b1b")(b1, train),
+                              cb(256, (3, 1), name="b1c")(b1, train)],
+                             axis=-1)
+        b2 = cb(512, (1, 3), name="b2b")(
+            cb(448, (3, 1), name="b2a")(
+                cb(384, (1, 1), name="b2z")(x, train), train), train)
+        b2 = jnp.concatenate([cb(256, (1, 3), name="b2c")(b2, train),
+                              cb(256, (3, 1), name="b2d")(b2, train)],
+                             axis=-1)
+        b3 = cb(256, (1, 1), name="b3")(_pool(x, "avg"), train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionV4(nn.Module):
+    """Stem + 4A + RedA + 7B + RedB + 3C (inceptionV4.py:1-335)."""
+    num_classes: int = 1000
+    blocks: Tuple[int, int, int] = (4, 7, 3)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        x = cb(32, (3, 3), (2, 2), name="s1")(x, train)
+        x = cb(32, (3, 3), name="s2")(x, train)
+        x = cb(64, (3, 3), name="s3")(x, train)
+        x = jnp.concatenate([
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME"),
+            cb(96, (3, 3), (2, 2), name="s4")(x, train)], axis=-1)
+        a = cb(96, (3, 3), name="s5b")(
+            cb(64, (1, 1), name="s5a")(x, train), train)
+        b = x
+        for i, (f, k) in enumerate([(64, (1, 1)), (64, (1, 7)),
+                                    (64, (7, 1)), (96, (3, 3))]):
+            b = cb(f, k, name=f"s6{i}")(b, train)
+        x = jnp.concatenate([a, b], axis=-1)
+        x = jnp.concatenate([
+            cb(192, (3, 3), (2, 2), name="s7")(x, train),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")],
+            axis=-1)
+        for i in range(self.blocks[0]):
+            x = InceptionA(self.dtype, name=f"a{i}")(x, train)
+        x = jnp.concatenate([                       # reduction A
+            cb(384, (3, 3), (2, 2), name="ra0")(x, train),
+            cb(256, (3, 3), (2, 2), name="ra1c")(
+                cb(224, (3, 3), name="ra1b")(
+                    cb(192, (1, 1), name="ra1a")(x, train), train), train),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")],
+            axis=-1)
+        for i in range(self.blocks[1]):
+            x = InceptionB(self.dtype, name=f"b{i}")(x, train)
+        x = jnp.concatenate([                       # reduction B
+            cb(192, (3, 3), (2, 2), name="rb0b")(
+                cb(192, (1, 1), name="rb0a")(x, train), train),
+            cb(320, (3, 3), (2, 2), name="rb1d")(
+                cb(320, (7, 1), name="rb1c")(
+                    cb(256, (1, 7), name="rb1b")(
+                        cb(256, (1, 1), name="rb1a")(x, train), train),
+                    train), train),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")],
+            axis=-1)
+        for i in range(self.blocks[2]):
+            x = InceptionC(self.dtype, name=f"c{i}")(x, train)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# -------------------------------------------------------------------- DPN
+
+class DualPathBlock(nn.Module):
+    """1x1 → grouped 3x3 → 1x1 with the output split across a residual
+    path (first ``bw`` channels, added) and a dense path (last ``inc``
+    channels, concatenated) — dpn.py DualPathBlock."""
+    r: int                    # bottleneck width
+    bw: int                   # residual width
+    inc: int                  # dense growth
+    groups: int
+    stride: int = 1
+    has_proj: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, carry, train: bool = False):
+        res, dense = carry
+        x = jnp.concatenate([res, dense], axis=-1)
+        if self.has_proj:
+            p = ConvBN(self.bw + 2 * self.inc, (1, 1),
+                       (self.stride,) * 2, relu=False, dtype=self.dtype,
+                       name="proj")(x, train)
+            res, dense = p[..., :self.bw], p[..., self.bw:]
+        y = ConvBN(self.r, (1, 1), dtype=self.dtype, name="c1")(x, train)
+        y = ConvBN(self.r, (3, 3), (self.stride,) * 2, groups=self.groups,
+                   dtype=self.dtype, name="c2")(y, train)
+        y = ConvBN(self.bw + self.inc, (1, 1), relu=False,
+                   dtype=self.dtype, name="c3")(y, train)
+        return (res + y[..., :self.bw],
+                jnp.concatenate([dense, y[..., self.bw:]], axis=-1))
+
+
+class DPN(nn.Module):
+    """Dual Path Network (dpn.py:1-381). k_sec blocks per stage; stage s
+    has residual width bw0*2^s, bottleneck r0*2^s, dense growth inc[s]."""
+    num_classes: int = 1000
+    k_sec: Sequence[int] = (3, 4, 20, 3)
+    inc_sec: Sequence[int] = (16, 32, 24, 128)
+    r0: int = 96
+    bw0: int = 256
+    groups: int = 32
+    stem: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvBN(self.stem, (7, 7), (2, 2), dtype=self.dtype,
+                   name="stem")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        carry = (x, x[..., :0])
+        for s, (n, inc) in enumerate(zip(self.k_sec, self.inc_sec)):
+            bw, r = self.bw0 * 2 ** s, self.r0 * 2 ** s
+            for i in range(n):
+                carry = DualPathBlock(
+                    r, bw, inc, self.groups,
+                    stride=2 if (i == 0 and s > 0) else 1,
+                    has_proj=(i == 0), dtype=self.dtype,
+                    name=f"s{s}b{i}")(carry, train)
+        x = jnp.concatenate(carry, axis=-1)
+        x = nn.relu(x).mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# ----------------------------------------------------------------- NASNet
+
+class FitReduce(nn.Module):
+    """1x1 fit of a cell input to ``features``; factorized stride-2
+    reduction when the spatial dims are larger than the reference input
+    (nasnet.py CellStem/first-cell path adjustment)."""
+    features: int
+    reduce: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.reduce:
+            a = nn.avg_pool(x, (1, 1), strides=(2, 2))
+            b = nn.avg_pool(x[:, 1:, 1:], (1, 1), strides=(2, 2))
+            b = jnp.pad(b, [(0, 0), (0, a.shape[1] - b.shape[1]),
+                            (0, a.shape[2] - b.shape[2]), (0, 0)])
+            x = jnp.concatenate([
+                nn.Conv(self.features // 2, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="p1")(nn.relu(a)),
+                nn.Conv(self.features - self.features // 2, (1, 1),
+                        use_bias=False, dtype=self.dtype, name="p2")(
+                    nn.relu(b))], axis=-1)
+            return nn.BatchNorm(use_running_average=not train,
+                                momentum=0.9, dtype=self.dtype,
+                                name="bn")(x)
+        return ConvBN(self.features, (1, 1), dtype=self.dtype,
+                      name="fit")(x, train)
+
+
+class NormalCell(nn.Module):
+    """NASNet-A normal cell: 5 pairwise combines over (h, h_prev)
+    (nasnet.py NormalCell; wiring per the NASNet-A paper figure)."""
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, h_prev, train: bool = False):
+        f = self.features
+        sep = partial(SepConvBN, dtype=self.dtype)
+        h = FitReduce(f, dtype=self.dtype, name="fit_h")(h, train)
+        hp = FitReduce(f, reduce=h_prev.shape[1] != h.shape[1],
+                       dtype=self.dtype, name="fit_hp")(h_prev, train)
+        c0 = sep(f, (3, 3), name="c0")(h, train) + h
+        c1 = sep(f, (3, 3), name="c1a")(hp, train) + \
+            sep(f, (5, 5), name="c1b")(h, train)
+        c2 = _pool(h, "avg") + hp
+        c3 = _pool(hp, "avg") + _pool(hp, "avg")
+        c4 = sep(f, (5, 5), name="c4a")(hp, train) + \
+            sep(f, (3, 3), name="c4b")(hp, train)
+        return jnp.concatenate([hp, c0, c1, c2, c3, c4], axis=-1)
+
+
+class ReductionCell(nn.Module):
+    """NASNet-A reduction cell (stride-2 combines)."""
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, h_prev, train: bool = False):
+        f = self.features
+        s2 = (2, 2)
+        sep = partial(SepConvBN, dtype=self.dtype)
+        h = FitReduce(f, dtype=self.dtype, name="fit_h")(h, train)
+        hp = FitReduce(f, reduce=h_prev.shape[1] != h.shape[1],
+                       dtype=self.dtype, name="fit_hp")(h_prev, train)
+        c0 = sep(f, (7, 7), s2, name="c0a")(hp, train) + \
+            sep(f, (5, 5), s2, name="c0b")(h, train)
+        c1 = _pool(h, "max", strides=s2) + \
+            sep(f, (7, 7), s2, name="c1")(hp, train)
+        c2 = _pool(h, "avg", strides=s2) + \
+            sep(f, (5, 5), s2, name="c2")(hp, train)
+        c3 = _pool(h, "max", strides=s2) + \
+            sep(f, (3, 3), name="c3")(c0, train)
+        c4 = _pool(c0, "avg") + c1
+        return jnp.concatenate([c1, c2, c3, c4], axis=-1)
+
+
+class NASNetA(nn.Module):
+    """NASNet-A (nasnet.py:1-643): stem → (N normal + reduction) ×3 −
+    final reduction, doubling filters at each reduction."""
+    num_classes: int = 1000
+    filters: int = 44
+    n_normal: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvBN(32, (3, 3), (2, 2), relu=False, dtype=self.dtype,
+                   name="stem")(x, train)
+        f = self.filters
+        h0 = ReductionCell(f // 2, dtype=self.dtype, name="stem0")(
+            x, x, train)
+        h1 = ReductionCell(f, dtype=self.dtype, name="stem1")(
+            h0, x, train)
+        h_prev, h = h0, h1
+        for stage in range(3):
+            for i in range(self.n_normal):
+                out = NormalCell(f * 2 ** stage, dtype=self.dtype,
+                                 name=f"n{stage}_{i}")(h, h_prev, train)
+                h_prev, h = h, out
+            if stage < 2:
+                out = ReductionCell(f * 2 ** (stage + 1),
+                                    dtype=self.dtype,
+                                    name=f"r{stage}")(h, h_prev, train)
+                h_prev, h = h, out
+        x = nn.relu(h).mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- PolyNet
+
+class InceptionResUnit(nn.Module):
+    """Inception-ResNet residual F used inside poly compositions
+    (ployNet.py BlockA/B/C analogs). Returns the residual branch only."""
+    kind: str                 # "a" | "b" | "c"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        c = x.shape[-1]
+        if self.kind == "a":
+            b0 = cb(32, (1, 1), name="b0")(x, train)
+            b1 = cb(32, (3, 3), name="b1b")(
+                cb(32, (1, 1), name="b1a")(x, train), train)
+            b2 = cb(64, (3, 3), name="b2c")(
+                cb(48, (3, 3), name="b2b")(
+                    cb(32, (1, 1), name="b2a")(x, train), train), train)
+            y = jnp.concatenate([b0, b1, b2], axis=-1)
+        elif self.kind == "b":
+            b0 = cb(192, (1, 1), name="b0")(x, train)
+            b1 = cb(192, (7, 1), name="b1c")(
+                cb(160, (1, 7), name="b1b")(
+                    cb(128, (1, 1), name="b1a")(x, train), train), train)
+            y = jnp.concatenate([b0, b1], axis=-1)
+        else:
+            b0 = cb(192, (1, 1), name="b0")(x, train)
+            b1 = cb(256, (3, 1), name="b1c")(
+                cb(224, (1, 3), name="b1b")(
+                    cb(192, (1, 1), name="b1a")(x, train), train), train)
+            y = jnp.concatenate([b0, b1], axis=-1)
+        return ConvBN(c, (1, 1), relu=False, dtype=self.dtype,
+                      name="proj")(y, train)
+
+
+class PolyBlock(nn.Module):
+    """Polynomial composition (ployNet.py poly/mpoly/2-way):
+    poly2:  x + F(x) + F(F(x))    (shared F)
+    mpoly2: x + F(x) + G(F(x))
+    2way:   x + F(x) + G(x)
+    with the paper's beta residual scaling."""
+    kind: str
+    mode: str = "poly2"
+    beta: float = 0.3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = InceptionResUnit(self.kind, dtype=self.dtype, name="f")
+        fx = f(x, train)
+        if self.mode == "poly2":
+            second = f(nn.relu(x + self.beta * fx), train)
+        elif self.mode == "mpoly2":
+            second = InceptionResUnit(self.kind, dtype=self.dtype,
+                                      name="g")(
+                nn.relu(x + self.beta * fx), train)
+        else:
+            second = InceptionResUnit(self.kind, dtype=self.dtype,
+                                      name="g")(x, train)
+        return nn.relu(x + self.beta * (fx + second))
+
+
+class PolyNet(nn.Module):
+    """PolyNet (ployNet.py:1-490): inception-resnet-v2 trunk with
+    poly-2/2-way mixed stages A/B/C."""
+    num_classes: int = 1000
+    stage_blocks: Tuple[int, int, int] = (10, 10, 5)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, dtype=self.dtype)
+        x = cb(32, (3, 3), (2, 2), name="s1")(x, train)
+        x = cb(64, (3, 3), name="s2")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = cb(80, (1, 1), name="s3")(x, train)
+        x = cb(192, (3, 3), name="s4")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = cb(384, (1, 1), name="s5")(x, train)
+        modes = ["2way", "poly2", "mpoly2"]
+        for i in range(self.stage_blocks[0]):
+            x = PolyBlock("a", modes[i % 3], dtype=self.dtype,
+                          name=f"a{i}")(x, train)
+        x = jnp.concatenate([                       # reduction A
+            cb(384, (3, 3), (2, 2), name="ra0")(x, train),
+            cb(384, (3, 3), (2, 2), name="ra1c")(
+                cb(256, (3, 3), name="ra1b")(
+                    cb(256, (1, 1), name="ra1a")(x, train), train), train),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")],
+            axis=-1)
+        for i in range(self.stage_blocks[1]):
+            x = PolyBlock("b", modes[i % 3], dtype=self.dtype,
+                          name=f"b{i}")(x, train)
+        x = jnp.concatenate([                       # reduction B
+            cb(384, (3, 3), (2, 2), name="rb0b")(
+                cb(256, (1, 1), name="rb0a")(x, train), train),
+            cb(384, (3, 3), (2, 2), name="rb1b")(
+                cb(256, (1, 1), name="rb1a")(x, train), train),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")],
+            axis=-1)
+        for i in range(self.stage_blocks[2]):
+            x = PolyBlock("c", modes[i % 3], dtype=self.dtype,
+                          name=f"c{i}")(x, train)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# -------------------------------------------------------------- SENet-154
+
+class SEBottleneck(nn.Module):
+    """SENet-154 bottleneck: double-width 1x1, grouped 3x3, SE(16)
+    (senet.py SEBottleneck)."""
+    features: int
+    stride: int = 1
+    groups: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        res = x
+        if self.stride != 1 or x.shape[-1] != self.features * 4:
+            res = ConvBN(self.features * 4, (1, 1), (self.stride,) * 2,
+                         relu=False, dtype=self.dtype, name="down")(
+                x, train)
+        y = ConvBN(self.features * 2, (1, 1), dtype=self.dtype,
+                   name="c1")(x, train)
+        y = ConvBN(self.features * 4, (3, 3), (self.stride,) * 2,
+                   groups=self.groups, dtype=self.dtype, name="c2")(
+            y, train)
+        y = ConvBN(self.features * 4, (1, 1), relu=False,
+                   dtype=self.dtype, name="c3")(y, train)
+        y = SEModule(reduction=16, dtype=self.dtype, name="se")(y)
+        return nn.relu(y + res)
+
+
+class SENet154(nn.Module):
+    """SENet-154 (senet.py:1-449): 3-conv deep stem + SEBottleneck
+    stages (3, 8, 36, 3)."""
+    num_classes: int = 1000
+    blocks: Sequence[int] = (3, 8, 36, 3)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvBN(64, (3, 3), (2, 2), dtype=self.dtype, name="s1")(
+            x, train)
+        x = ConvBN(64, (3, 3), dtype=self.dtype, name="s2")(x, train)
+        x = ConvBN(128, (3, 3), dtype=self.dtype, name="s3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for s, n in enumerate(self.blocks):
+            for i in range(n):
+                x = SEBottleneck(64 * 2 ** s,
+                                 stride=2 if (i == 0 and s > 0) else 1,
+                                 dtype=self.dtype,
+                                 name=f"s{s}b{i}")(x, train)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("xception")
+def xception(num_classes: int = 1000, **kw):
+    return Xception(num_classes=num_classes, **kw)
+
+
+@MODELS.register("inception_v4")
+def inception_v4(num_classes: int = 1000, **kw):
+    return InceptionV4(num_classes=num_classes, **kw)
+
+
+@MODELS.register("dpn92")
+def dpn92(num_classes: int = 1000, **kw):
+    return DPN(num_classes=num_classes, **kw)
+
+
+@MODELS.register("dpn68")
+def dpn68(num_classes: int = 1000, **kw):
+    cfg = dict(k_sec=(3, 4, 12, 3), inc_sec=(16, 32, 32, 64), r0=32,
+               bw0=64, stem=16, groups=32)
+    cfg.update(kw)
+    return DPN(num_classes=num_classes, **cfg)
+
+
+@MODELS.register("nasnet_a_mobile")
+def nasnet_a_mobile(num_classes: int = 1000, **kw):
+    return NASNetA(num_classes=num_classes, **kw)
+
+
+@MODELS.register("polynet")
+def polynet(num_classes: int = 1000, **kw):
+    return PolyNet(num_classes=num_classes, **kw)
+
+
+@MODELS.register("senet154")
+def senet154(num_classes: int = 1000, **kw):
+    return SENet154(num_classes=num_classes, **kw)

@@ -1,1 +1,1 @@
-from . import bdb  # noqa: F401
+from . import bdb, mask_crop  # noqa: F401

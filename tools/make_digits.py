@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Materialize real-image datasets for offline end-to-end training.
+
+The image has no bundled ImageNet/COCO, so the real-data pipeline is
+proven on sklearn's bundled *digits* dataset (1797 real handwritten-digit
+scans, the classic UCI test set):
+
+- ``cls``: upscaled digit scans written as an ImageFolder of real JPEGs
+  (root/<class>/*.jpg) — exercises the same scan/decode/augment path an
+  ImageNet folder would (dataLoader/build.py capability).
+- ``det``: digits composited onto textured canvases with recorded boxes,
+  written as images/ + COCO-format instances.json — exercises the COCO
+  json + JPEG decode detection path (YOLOX datasets/coco.py capability).
+
+Usage: python tools/make_digits.py --root /root/data/digits --which both
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_digits_images():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    # (N, 8, 8) float 0..16 → uint8 grayscale 0..255
+    imgs = (d.images / 16.0 * 255.0).astype(np.uint8)
+    return imgs, d.target.astype(np.int32)
+
+
+def make_cls(root: str, size: int = 64, quality: int = 90) -> int:
+    from PIL import Image
+    imgs, labels = load_digits_images()
+    for c in range(10):
+        os.makedirs(os.path.join(root, str(c)), exist_ok=True)
+    for i, (im, lab) in enumerate(zip(imgs, labels)):
+        pil = Image.fromarray(im, "L").resize((size, size), Image.BICUBIC)
+        pil.convert("RGB").save(
+            os.path.join(root, str(lab), f"digit_{i:04d}.jpg"),
+            quality=quality)
+    return len(imgs)
+
+
+def make_det(root: str, n_images: int = 800, canvas: int = 256,
+             max_obj: int = 5, seed: int = 0) -> int:
+    from PIL import Image
+    imgs, labels = load_digits_images()
+    rng = np.random.default_rng(seed)
+    img_dir = os.path.join(root, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    coco = {"images": [], "annotations": [],
+            "categories": [{"id": c + 1, "name": str(c)} for c in range(10)]}
+    ann_id = 1
+    for img_id in range(n_images):
+        # textured background so detection isn't trivially thresholdable
+        bg = rng.normal(96, 24, (canvas, canvas)).clip(0, 255)
+        n_obj = int(rng.integers(1, max_obj + 1))
+        for _ in range(n_obj):
+            j = int(rng.integers(0, len(imgs)))
+            side = int(rng.integers(28, 72))
+            digit = np.asarray(
+                Image.fromarray(imgs[j], "L").resize((side, side),
+                                                     Image.BICUBIC),
+                np.float32)
+            x0 = int(rng.integers(0, canvas - side))
+            y0 = int(rng.integers(0, canvas - side))
+            patch = bg[y0:y0 + side, x0:x0 + side]
+            bg[y0:y0 + side, x0:x0 + side] = np.maximum(patch, digit)
+            coco["annotations"].append({
+                "id": ann_id, "image_id": img_id,
+                "category_id": int(labels[j]) + 1,
+                "bbox": [x0, y0, side, side],   # COCO xywh
+                "area": side * side, "iscrowd": 0})
+            ann_id += 1
+        fname = f"det_{img_id:05d}.jpg"
+        Image.fromarray(bg.astype(np.uint8), "L").convert("RGB").save(
+            os.path.join(img_dir, fname), quality=90)
+        coco["images"].append({"id": img_id, "file_name": fname,
+                               "width": canvas, "height": canvas})
+    with open(os.path.join(root, "instances.json"), "w") as f:
+        json.dump(coco, f)
+    return n_images
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="/root/data/digits")
+    ap.add_argument("--which", default="both",
+                    choices=["cls", "det", "both"])
+    ap.add_argument("--det-images", type=int, default=800)
+    args = ap.parse_args()
+    if args.which in ("cls", "both"):
+        n = make_cls(os.path.join(args.root, "cls"))
+        print(f"cls: wrote {n} JPEGs under {args.root}/cls")
+    if args.which in ("det", "both"):
+        n = make_det(os.path.join(args.root, "det"),
+                     n_images=args.det_images)
+        print(f"det: wrote {n} composited scenes under {args.root}/det")
+
+
+if __name__ == "__main__":
+    main()

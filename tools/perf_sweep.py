@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""ViT-B/16 training-step perf sweep on one TPU chip.
+
+Times the real train step (same construction as bench.py) across
+variants — batch size, attention softmax dtype, Pallas flash kernel —
+and prints a table of step-time / images-per-sec / MFU per variant.
+MFU uses XLA's compiled cost analysis like bench.py so numbers are
+comparable. Run on the real chip: `python tools/perf_sweep.py`.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in {"v6": 918e12, "v5p": 459e12, "v5": 197e12,
+                     "v4": 275e12, "v3": 123e12, "v2": 45e12}.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def bf16_softmax_attention(q, k, v, dropout_rate=0.0, deterministic=True,
+                           rng=None):
+    """Naive attention with softmax kept in bf16 (row max still exact)."""
+    del dropout_rate, deterministic, rng
+    scale = q.shape[-1] ** -0.5
+    attn = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    attn = jax.nn.softmax(attn, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+
+def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20):
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.train import TrainState, make_train_step
+    from deeplearning_tpu.train.classification import make_loss_fn
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+
+    model = MODELS.build("vit_base_patch16_224", num_classes=1000,
+                         attn_fn=attn_fn, remat=remat)
+    rng = jax.random.key(0)
+    params = model.init(rng, jnp.zeros((1, 224, 224, 3)), train=False)[
+        "params"]
+    sched = build_schedule("warmup_cosine", base_lr=1e-3,
+                           total_steps=10_000, warmup_steps=100)
+    tx = build_optimizer("adamw", sched, weight_decay=0.05, params=params)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    images = jnp.asarray(
+        np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
+        jnp.float32)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 1000, batch), jnp.int32)
+    data = {"image": images, "label": labels}
+    step = make_train_step(make_loss_fn(label_smoothing=0.1), donate=True)
+    compiled = jax.jit(lambda s, b, r: step(s, b, r),
+                       donate_argnums=(0,)).lower(state, data,
+                                                  rng).compile()
+    cost = compiled.cost_analysis()
+    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+
+    state, metrics = step(state, data, rng)
+    float(metrics["loss"])  # D2H sync (block_until_ready unreliable here)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, data, rng)
+    float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / n_steps
+    mfu = step_flops / dt / peak_flops(jax.devices()[0]) * 100.0
+    print(f"{name:40s} batch={batch:4d} step={dt * 1e3:8.2f}ms "
+          f"img/s={batch / dt:8.1f} mfu={mfu:6.2f}%", flush=True)
+    del state, compiled, step
+    return dt, mfu
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--set", default="batch",
+                    choices=["batch", "attn", "all"])
+    args = ap.parse_args()
+
+    from deeplearning_tpu.ops.attention import flash_attn_adapter
+
+    if args.set in ("batch", "all"):
+        for batch in (128, 160, 192, 256):
+            time_variant("naive_f32softmax", batch)
+    if args.set in ("attn", "all"):
+        time_variant("bf16_softmax", 128, attn_fn=bf16_softmax_attention)
+        time_variant("bf16_softmax", 256, attn_fn=bf16_softmax_attention)
+        time_variant("flash_pallas", 128, attn_fn=flash_attn_adapter)
+        time_variant("flash_pallas", 256, attn_fn=flash_attn_adapter)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,12 +42,15 @@ class ModelCfg:
 
 @dataclasses.dataclass(frozen=True)
 class DataCfg:
+    folder: Optional[str] = None     # ImageFolder root (real JPEG path)
     npz: Optional[str] = None        # npz with images/labels arrays
     synthetic: bool = True
     image_size: int = 28
     channels: int = 1
     n_train: int = 512
     global_batch: int = 64
+    val_rate: float = 0.2            # folder-mode train/val split
+    num_workers: int = 8             # folder-mode decode threads
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,15 +113,38 @@ def main(argv=None) -> int:
     from deeplearning_tpu.train.trainer import Trainer
 
     cfg = config_cli(Config(), argv, description=__doc__)
-    images, labels = load_data(cfg.data, cfg.model.num_classes)
+    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis))
+    if cfg.data.folder:
+        from deeplearning_tpu.data.build import (LoaderConfig,
+                                                 build_classification_loaders)
+        lcfg = LoaderConfig(global_batch=cfg.data.global_batch,
+                            image_size=cfg.data.image_size,
+                            val_rate=cfg.data.val_rate,
+                            num_workers=cfg.data.num_workers,
+                            seed=cfg.train.seed)
+        loader, eval_loader, class_to_idx = build_classification_loaders(
+            cfg.data.folder, lcfg, mesh=mesh,
+            class_indices_path=(os.path.join(cfg.train.workdir,
+                                             "class_indices.json")
+                                if cfg.train.workdir else None))
+        if len(class_to_idx) != cfg.model.num_classes:
+            raise ValueError(
+                f"model.num_classes={cfg.model.num_classes} but "
+                f"{cfg.data.folder} has {len(class_to_idx)} classes")
+        sample_shape = (1, cfg.data.image_size, cfg.data.image_size, 3)
+        n_train = len(loader) * cfg.data.global_batch
+    else:
+        images, labels = load_data(cfg.data, cfg.model.num_classes)
+        sample_shape = (1,) + images.shape[1:]
+        n_train = len(images)
     dtype = jnp.bfloat16 if cfg.model.precision == "bf16" else jnp.float32
     model = MODELS.build(cfg.model.name, num_classes=cfg.model.num_classes,
                          dtype=dtype)
-    sample = jnp.zeros((1,) + images.shape[1:])
+    sample = jnp.zeros(sample_shape)
     variables = model.init(jax.random.key(cfg.train.seed), sample,
                            train=False)
     params = variables["params"]
-    steps_per_epoch = len(images) // cfg.data.global_batch
+    steps_per_epoch = n_train // cfg.data.global_batch
     sched = build_schedule(cfg.optim.schedule, base_lr=cfg.optim.lr,
                            total_steps=cfg.train.epochs * steps_per_epoch,
                            warmup_steps=cfg.optim.warmup_steps)
@@ -131,15 +157,15 @@ def main(argv=None) -> int:
         batch_stats=variables.get("batch_stats", {}),
         use_ema=cfg.train.ema)
 
-    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis))
     state = shard_state(state, mesh)
     has_bn = bool(variables.get("batch_stats"))
-    loader = DataLoader(ArraySource(image=images, label=labels),
-                        global_batch=cfg.data.global_batch, mesh=mesh,
-                        seed=cfg.train.seed)
-    eval_loader = DataLoader(ArraySource(image=images, label=labels),
-                             global_batch=cfg.data.global_batch,
-                             mesh=mesh, shuffle=False)
+    if not cfg.data.folder:
+        loader = DataLoader(ArraySource(image=images, label=labels),
+                            global_batch=cfg.data.global_batch, mesh=mesh,
+                            seed=cfg.train.seed)
+        eval_loader = DataLoader(ArraySource(image=images, label=labels),
+                                 global_batch=cfg.data.global_batch,
+                                 mesh=mesh, shuffle=False)
     if cfg.data.global_batch % max(cfg.train.accum_steps, 1):
         raise ValueError(
             f"data.global_batch={cfg.data.global_batch} must be divisible "

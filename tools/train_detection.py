@@ -46,9 +46,13 @@ class DetModelCfg:
 @dataclasses.dataclass(frozen=True)
 class DetDataCfg:
     npz: Optional[str] = None
+    coco: Optional[str] = None       # instances.json (real JPEG path)
+    coco_images: Optional[str] = None  # default: <json dir>/images
     n_train: int = 32
     max_gt: int = 4
     batch: int = 8
+    val_rate: float = 0.1            # coco-mode eval split
+    num_workers: int = 8             # coco-mode decode threads
 
 
 @dataclasses.dataclass(frozen=True)
@@ -230,7 +234,33 @@ def main(argv=None) -> int:
 
     cfg = config_cli(DetConfig(), argv, description=__doc__)
     size = cfg.model.image_size
-    if cfg.data.npz:
+    num_classes = cfg.model.num_classes
+    train_src = val_src = None
+    if cfg.data.coco:
+        from deeplearning_tpu.data.coco import (coco_detection_source,
+                                                load_coco_json)
+        from deeplearning_tpu.data.loader import MapSource
+        records, class_names = load_coco_json(cfg.data.coco)
+        images_dir = cfg.data.coco_images or os.path.join(
+            os.path.dirname(cfg.data.coco), "images")
+        aug_src, _ = coco_detection_source(
+            images_dir=images_dir, records=records,
+            class_names=class_names, image_size=size,
+            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed)
+        raw_src, _ = coco_detection_source(
+            images_dir=images_dir, records=records,
+            class_names=class_names, image_size=size,
+            max_gt=cfg.data.max_gt, augment=False)
+        num_classes = len(class_names)
+        order = np.random.default_rng(cfg.train.seed).permutation(
+            len(aug_src))
+        n_val = max(int(len(aug_src) * cfg.data.val_rate), 1)
+        val_idx, tr_idx = order[:n_val], order[n_val:]
+        train_src = MapSource(len(tr_idx),
+                              lambda i: aug_src[int(tr_idx[i])])
+        val_src = MapSource(len(val_idx),
+                            lambda i: raw_src[int(val_idx[i])])
+    elif cfg.data.npz:
         blob = np.load(cfg.data.npz)
         images, boxes, labels, valid = (blob["images"], blob["boxes"],
                                         blob["labels"], blob["valid"])
@@ -239,11 +269,11 @@ def main(argv=None) -> int:
             cfg.data.n_train, size, cfg.model.num_classes,
             cfg.data.max_gt, cfg.train.seed)
 
-    model_classes = cfg.model.num_classes + (
+    model_classes = num_classes + (
         1 if cfg.model.name.startswith("fasterrcnn") else 0)  # +background
     model = MODELS.build(cfg.model.name, num_classes=model_classes)
     loss_fn_task, predict_fn = build_task(model, cfg.model.name,
-                                          cfg.model.num_classes,
+                                          num_classes,
                                           cfg.train.eval_score_thresh)
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
@@ -271,15 +301,28 @@ def main(argv=None) -> int:
         return (optax.apply_updates(params, updates), opt_state,
                 new_stats, total)
 
-    n = len(images)
     rng = np.random.default_rng(cfg.train.seed)
     key = jax.random.key(cfg.train.seed)
+    if train_src is not None:
+        from deeplearning_tpu.data.loader import DataLoader
+        loader = DataLoader(train_src, cfg.data.batch, shuffle=True,
+                            seed=cfg.train.seed, infinite=True,
+                            num_workers=cfg.data.num_workers)
+        batch_iter = iter(loader)
+        next_batch = lambda: {k: jnp.asarray(v) for k, v in
+                              next(batch_iter).items()}
+    else:
+        n = len(images)
+
+        def next_batch():
+            idx = rng.choice(n, cfg.data.batch, replace=False)
+            return {"image": jnp.asarray(images[idx]),
+                    "boxes": jnp.asarray(boxes[idx]),
+                    "labels": jnp.asarray(labels[idx]),
+                    "valid": jnp.asarray(valid[idx])}
+
     for it in range(cfg.train.steps):
-        idx = rng.choice(n, cfg.data.batch, replace=False)
-        batch = {"image": jnp.asarray(images[idx]),
-                 "boxes": jnp.asarray(boxes[idx]),
-                 "labels": jnp.asarray(labels[idx]),
-                 "valid": jnp.asarray(valid[idx])}
+        batch = next_batch()
         if schedule is not None:
             batch = resize_detection_batch(batch,
                                            schedule.size_for_step(it))
@@ -288,16 +331,40 @@ def main(argv=None) -> int:
         if it % max(cfg.train.steps // 5, 1) == 0:
             print(f"step {it}: loss={float(total):.4f}")
 
-    # ---- evaluate on the training set (smoke metric)
-    det = predict_fn(params, stats, jnp.asarray(images))
-    ev = CocoEvaluator(num_classes=cfg.model.num_classes)
-    for i in range(n):
-        keep = np.asarray(det["valid"][i])
-        ev.add_image(
-            i, gt_boxes=boxes[i][valid[i]], gt_labels=labels[i][valid[i]],
-            det_boxes=np.asarray(det["boxes"][i])[keep],
-            det_scores=np.asarray(det["scores"][i])[keep],
-            det_labels=np.asarray(det["labels"][i])[keep])
+    # ---- evaluate: coco mode on the held-out split, else train set
+    ev = CocoEvaluator(num_classes=num_classes)
+    predict_jit = jax.jit(predict_fn)
+    if val_src is not None:
+        bs = cfg.data.batch
+        n_val = len(val_src)
+        for start in range(0, n_val, bs):
+            # pad the tail chunk to the jitted batch shape, score only
+            # the real images
+            idx = np.minimum(np.arange(start, start + bs), n_val - 1)
+            n_real = min(bs, n_val - start)
+            sample = val_src[idx]
+            det = predict_jit(params, stats,
+                              jnp.asarray(sample["image"]))
+            for j in range(n_real):
+                keep = np.asarray(det["valid"][j])
+                gv = sample["valid"][j]
+                ev.add_image(
+                    start + j,
+                    gt_boxes=sample["boxes"][j][gv],
+                    gt_labels=sample["labels"][j][gv],
+                    det_boxes=np.asarray(det["boxes"][j])[keep],
+                    det_scores=np.asarray(det["scores"][j])[keep],
+                    det_labels=np.asarray(det["labels"][j])[keep])
+    else:
+        det = predict_fn(params, stats, jnp.asarray(images))
+        for i in range(len(images)):
+            keep = np.asarray(det["valid"][i])
+            ev.add_image(
+                i, gt_boxes=boxes[i][valid[i]],
+                gt_labels=labels[i][valid[i]],
+                det_boxes=np.asarray(det["boxes"][i])[keep],
+                det_scores=np.asarray(det["scores"][i])[keep],
+                det_labels=np.asarray(det["labels"][i])[keep])
     summary = ev.summarize()
     print({k: round(v, 4) for k, v in summary.items()})
     return 0
