@@ -75,6 +75,14 @@ def merge_dict(cfg: T, overrides: Dict[str, Any], strict: bool = True) -> T:
     if not dataclasses.is_dataclass(cfg):
         raise TypeError(f"merge_dict expects a dataclass, got {type(cfg)}")
     field_map = {f.name: f for f in dataclasses.fields(cfg)}
+    # resolve string annotations (`from __future__ import annotations`
+    # makes f.type the STRING "float", which _coerce would skip — a CLI
+    # "1e-4" would then survive as a string into optax)
+    try:
+        import typing
+        hints = typing.get_type_hints(type(cfg))
+    except Exception:                                    # noqa: BLE001
+        hints = {}
     updates = {}
     for key, value in overrides.items():
         if key == _BASE_KEY:
@@ -90,9 +98,8 @@ def merge_dict(cfg: T, overrides: Dict[str, Any], strict: bool = True) -> T:
         if dataclasses.is_dataclass(current) and isinstance(value, dict):
             updates[key] = merge_dict(current, value, strict=strict)
         else:
-            updates[key] = _coerce(value, field_map[key].type_resolved
-                                   if hasattr(field_map[key], "type_resolved")
-                                   else field_map[key].type)
+            updates[key] = _coerce(value, hints.get(key,
+                                                    field_map[key].type))
     return dataclasses.replace(cfg, **updates)
 
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from .datasets import folder_source, read_split_data, write_class_indices
 from .loader import DataLoader, prefetch_to_device
-from .transforms import eval_image_transform, train_image_transform
+from .transforms import eval_image_transform, get_train_transform
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +39,7 @@ class LoaderConfig:
     lookahead: int = 4
     seed: int = 0
     prefetch: int = 2
+    augment: str = "imagenet"        # imagenet | light | none
 
 
 def build_classification_loaders(
@@ -56,7 +57,8 @@ def build_classification_loaders(
     if class_indices_path:
         write_class_indices(split["class_to_idx"], class_indices_path)
     size = (cfg.image_size, cfg.image_size)
-    tt = train_transform or train_image_transform(size, seed=cfg.seed)
+    tt = train_transform or get_train_transform(cfg.augment, size,
+                                                seed=cfg.seed)
     et = eval_transform or eval_image_transform(size)
     train = DataLoader(
         folder_source(split["train_paths"], split["train_labels"], tt),
@@ -64,12 +66,19 @@ def build_classification_loaders(
         num_workers=cfg.num_workers, lookahead=cfg.lookahead)
     # clamp the val batch so a split smaller than global_batch still
     # yields batches (drop-last would otherwise drop the whole set);
-    # keep it divisible by process count
+    # keep it divisible by process count, repeating tail paths when the
+    # split is smaller than the process count (multi-host degenerate
+    # case — a duplicated val image beats an empty evaluation)
     n_proc = jax.process_count()
+    val_paths = list(split["val_paths"])
+    val_labels = list(split["val_labels"])
+    while val_paths and len(val_paths) % n_proc:
+        val_paths.append(val_paths[-1])
+        val_labels.append(val_labels[-1])
     val_batch = min(cfg.global_batch,
-                    max(len(split["val_paths"]) // n_proc, 1) * n_proc)
+                    max(len(val_paths) // n_proc, 1) * n_proc)
     val = DataLoader(
-        folder_source(split["val_paths"], split["val_labels"], et),
+        folder_source(val_paths, np.asarray(val_labels), et),
         val_batch, shuffle=False, seed=cfg.seed, mesh=mesh,
         num_workers=cfg.num_workers, lookahead=cfg.lookahead)
     return train, val, split["class_to_idx"]
@@ -83,17 +92,21 @@ def device_iterator(loader: DataLoader, cfg: LoaderConfig, sharding=None):
 
 def measure_throughput(loader: DataLoader, n_batches: int = 30,
                        warmup: int = 2) -> float:
-    """Host-pipeline images/sec (decode+augment+batch, no device work).
-    The proof the feed outruns the step rate (VERDICT: ≥ the 960 img/s
-    ViT-B step rate means data is not the MFU ceiling)."""
+    """Host-pipeline images/sec (decode+augment+batch, no device work),
+    cycling epochs if the loader is shorter than warmup+n_batches."""
+    import itertools
     import time
-    it = iter(loader)
+
+    def cycle():
+        while True:
+            yield from iter(loader)
+
+    it = cycle()
     n = 0
     for _ in range(warmup):
         next(it)
     t0 = time.perf_counter()
-    for _ in range(n_batches):
-        batch = next(it)
+    for batch in itertools.islice(it, n_batches):
         n += len(next(iter(batch.values())))
     dt = time.perf_counter() - t0
     return n / dt

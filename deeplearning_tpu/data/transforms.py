@@ -120,20 +120,13 @@ def color_jitter(img: np.ndarray, rng: np.random.Generator,
 
 
 def classification_train_transform(out_hw=(224, 224), seed: int = 0):
-    """Batch-level augment closure for DataLoader(transform=...): the
-    loader passes a dict of stacked arrays; augmentation runs per sample
-    with an owned numpy rng (advances every batch — deterministic given
-    seed and call order)."""
-    rng = np.random.default_rng(seed)
+    """Batch-level wrapper over train_image_transform for
+    DataLoader(transform=...)."""
+    one = train_image_transform(out_hw, seed)
 
     def fn(batch: Dict) -> Dict:
-        out = []
-        for img in batch["image"]:
-            img = random_resized_crop(img, rng, out_hw)
-            img = random_flip_lr(img, rng)
-            img = color_jitter(img, rng)
-            out.append(normalize(img))
-        return {**batch, "image": np.stack(out)}
+        return {**batch, "image": np.stack([one(i)
+                                            for i in batch["image"]])}
     return fn
 
 
@@ -165,6 +158,41 @@ def train_image_transform(out_hw=(224, 224), seed: int = 0):
         img = color_jitter(img, rng)
         return normalize(img)
     return fn
+
+
+def light_image_transform(out_hw=(224, 224), seed: int = 0,
+                          shift_frac: float = 0.1, flip: bool = False):
+    """Per-IMAGE light augment: resize + random shift (pad-and-crop) —
+    the small-image recipe (CIFAR/digits style) where ImageNet-strength
+    RandomResizedCrop would destroy the object."""
+    import threading
+    local = threading.local()
+
+    def fn(img: np.ndarray) -> np.ndarray:
+        rng = thread_rng(local, seed)
+        img = resize_bilinear(img, out_hw)
+        ph = max(int(out_hw[0] * shift_frac), 1)
+        pw = max(int(out_hw[1] * shift_frac), 1)
+        img = np.pad(img, [(ph, ph), (pw, pw), (0, 0)], mode="edge")
+        y0 = rng.integers(0, 2 * ph + 1)
+        x0 = rng.integers(0, 2 * pw + 1)
+        img = img[y0:y0 + out_hw[0], x0:x0 + out_hw[1]]
+        if flip:
+            img = random_flip_lr(img, rng)
+        return normalize(img)
+    return fn
+
+
+def get_train_transform(preset: str, out_hw=(224, 224), seed: int = 0):
+    """Augmentation preset registry for the classification pipeline:
+    'imagenet' (RRC+flip+jitter), 'light' (resize+shift), 'none'."""
+    if preset == "imagenet":
+        return train_image_transform(out_hw, seed)
+    if preset == "light":
+        return light_image_transform(out_hw, seed)
+    if preset == "none":
+        return eval_image_transform(out_hw, crop_frac=1.0)
+    raise ValueError(f"unknown augment preset {preset!r}")
 
 
 def eval_image_transform(out_hw=(224, 224), crop_frac=0.875):

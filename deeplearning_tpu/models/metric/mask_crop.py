@@ -49,8 +49,16 @@ def crop_by_mask(image: np.ndarray, mask: np.ndarray,
                  threshold: float = 0.5, pad_frac: float = 0.05
                  ) -> np.ndarray:
     """Crop ``image`` to the mask bbox (optionally resized to out_hw) —
-    the data_loader.py:110-130 crop-before-augment step."""
+    the data_loader.py:110-130 crop-before-augment step. The mask may be
+    at a different resolution than the image (stage 1 predicts at a
+    fixed size); the bbox is rescaled into image space."""
     x0, y0, x1, y1 = mask_to_bbox(mask, threshold, pad_frac)
+    ih, iw = image.shape[:2]
+    mh, mw = mask.shape[:2]
+    if (mh, mw) != (ih, iw):
+        sx, sy = iw / mw, ih / mh
+        x0, x1 = int(x0 * sx), min(int(round(x1 * sx)), iw)
+        y0, y1 = int(y0 * sy), min(int(round(y1 * sy)), ih)
     crop = image[y0:y1, x0:x1]
     if out_hw is not None:
         crop = resize_bilinear(crop, out_hw)

@@ -13,25 +13,44 @@ from typing import Callable, Optional
 
 import jax
 
-from .pallas.flash_attention import flash_attention_bnhd
+from .pallas.flash_attention import flash_attention, flash_attention_hb
 
 
-def flash_attn_adapter(q, k, v, dropout_rate: float = 0.0,
-                       deterministic: bool = True,
-                       rng: Optional[jax.Array] = None):
-    """(B, N, H, D) adapter matching models' attn_fn signature."""
+def _check_no_dropout(dropout_rate: float, deterministic: bool):
     if dropout_rate > 0.0 and not deterministic:
         raise NotImplementedError(
             "flash attention does not implement attention dropout; set "
             "attn_drop_rate=0 (use drop_path for regularization) or use "
             "the naive attention path.")
+
+
+def flash_attn_adapter(q, k, v, dropout_rate: float = 0.0,
+                       deterministic: bool = True,
+                       rng: Optional[jax.Array] = None):
+    """(B, N, H, D) adapter matching models' attn_fn signature (per-head
+    kernel — the long-N path)."""
+    _check_no_dropout(dropout_rate, deterministic)
     del rng
-    return flash_attention_bnhd(q, k, v)
+    t = lambda x: x.transpose(0, 2, 1, 3)
+    return t(flash_attention(t(q), t(k), t(v)))
+
+
+def flash_hb_adapter(q, k, v, dropout_rate: float = 0.0,
+                     deterministic: bool = True,
+                     rng: Optional[jax.Array] = None):
+    """(B, N, H, D) adapter for the head-batched kernel — the short-N
+    path (ViT/MAE token counts), trainable."""
+    _check_no_dropout(dropout_rate, deterministic)
+    del rng
+    t = lambda x: x.transpose(0, 2, 1, 3)
+    return t(flash_attention_hb(t(q), t(k), t(v)))
 
 
 def get_attn_fn(name: str = "flash") -> Optional[Callable]:
     if name in ("flash", "pallas"):
         return flash_attn_adapter
+    if name in ("flash_hb", "pallas_hb", "head_batched"):
+        return flash_hb_adapter
     if name in ("naive", "lax", "reference"):
         return None  # models fall back to their built-in naive path
     raise ValueError(f"Unknown attention implementation {name!r}")

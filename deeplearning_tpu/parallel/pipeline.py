@@ -36,6 +36,19 @@ def pipeline_apply(
     stage_fn(params_slice, activation) -> activation, applied by every
     device to the microbatch currently resident on it.
     """
+    return _pipeline_schedule(stage_fn, stage_params, x, mesh, axis_name)
+
+
+def _pipeline_schedule(
+    apply_stage: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,           # pytree with leading S axis on leaves
+    x: jax.Array,                # (M, micro_batch, ...) microbatches
+    mesh: Mesh,
+    axis_name: str,
+) -> jax.Array:
+    """The shared GPipe fill-drain schedule: apply_stage runs on each
+    device with its de-stacked param slice and the resident microbatch,
+    then activations ppermute one stage forward."""
     s = mesh.shape[axis_name]
     m = x.shape[0]
 
@@ -61,7 +74,7 @@ def pipeline_apply(
             feed = jnp.where(t < m, t, 0)
             incoming = jnp.where(idx == 0, 1.0, 0.0)
             inject = all_x[feed] * incoming + buf * (1 - incoming)
-            y = stage_fn(params, inject)
+            y = apply_stage(params, inject)
             # device s-1's output at tick t is microbatch t-(s-1)
             out_slot = t - (s - 1)
             is_last = idx == s - 1
@@ -92,3 +105,75 @@ def stack_stage_params(params_list) -> Any:
     """[stage0_params, stage1_params, ...] (same structure) → stacked
     pytree with leading S axis, ready for P('model') sharding."""
     return jax.tree.map(lambda *ps: jnp.stack(ps), *params_list)
+
+
+# -------------------------------------------------- heterogeneous stages
+
+def pack_stages(params_list) -> Tuple[jax.Array, list]:
+    """Pack per-stage param pytrees of DIFFERENT structures into one
+    (S, L) f32 array (rows zero-padded to the longest stage) plus
+    per-stage unpack closures. This is what lets a pipeline span e.g.
+    ResNet stages whose block structures differ: the packed rows all
+    have the same shape, so they shard over the pipe axis like any
+    stacked pytree, and each device reconstitutes its own stage's
+    structure locally."""
+    import numpy as np
+
+    flats, unpackers = [], []
+    for p in params_list:
+        leaves, treedef = jax.tree.flatten(p)
+        shapes = [l.shape for l in leaves]
+        dtypes = [l.dtype for l in leaves]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        offs = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+        flat = (jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                                 for l in leaves])
+                if leaves else jnp.zeros((0,), jnp.float32))
+        flats.append(flat)
+
+        def make_unpack(treedef=treedef, shapes=shapes, dtypes=dtypes,
+                        offs=offs):
+            def unpack(vec: jax.Array):
+                ls = [vec[offs[i]:offs[i + 1]].reshape(shapes[i])
+                      .astype(dtypes[i]) for i in range(len(shapes))]
+                return jax.tree.unflatten(treedef, ls)
+            return unpack
+        unpackers.append(make_unpack())
+    length = max((f.shape[0] for f in flats), default=1)
+    packed = jnp.stack([jnp.pad(f, (0, length - f.shape[0]))
+                        for f in flats])
+    return packed, unpackers
+
+
+def pipeline_apply_heterogeneous(
+    stage_fns,                   # [fn_i(params_i, act) -> act] per stage
+    params_list,                 # per-stage pytrees, any structures
+    x: jax.Array,                # (M, micro_batch, ...) microbatches
+    mesh: Mesh,
+    axis_name: str = PIPE_AXIS,
+) -> jax.Array:
+    """GPipe schedule over stages with different parameter structures.
+
+    Stage params are packed (pack_stages) so every device's shard has
+    the same shape; each device dispatches to ITS stage's function via
+    ``lax.switch`` on its mesh coordinate (every branch is compiled
+    once, the device executes only its own — the SPMD analog of
+    per-rank module code in torch pipelines). Activations must still be
+    shape-uniform across stage boundaries (the ppermute buffer is
+    static); insert adapter layers at stage edges if a model changes
+    activation shape.
+    """
+    s = mesh.shape[axis_name]
+    if len(stage_fns) != s or len(params_list) != s:
+        raise ValueError(f"need exactly {s} stages for axis "
+                         f"{axis_name!r}, got {len(stage_fns)}")
+    packed, unpackers = pack_stages(params_list)
+    branches = [
+        (lambda row, act, f=fn, u=unpack: f(u(row), act))
+        for fn, unpack in zip(stage_fns, unpackers)]
+
+    def dispatch(row, act):
+        idx = jax.lax.axis_index(axis_name)
+        return jax.lax.switch(idx, branches, row, act)
+
+    return _pipeline_schedule(dispatch, packed, x, mesh, axis_name)

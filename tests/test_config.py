@@ -1,0 +1,56 @@
+"""Config system: dataclass tree + YAML + dotted CLI overrides
+(core/config.py — the cfg/flag-system surface, SURVEY §5)."""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import pytest
+
+from deeplearning_tpu.core.config import config_cli, merge_dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    lr: float = 0.1
+    steps: int = 10
+    name: str = "sgd"
+    sizes: Tuple[int, ...] = (1, 2)
+    npz: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Cfg:
+    inner: Inner = dataclasses.field(default_factory=Inner)
+    flag: bool = False
+
+
+def test_cli_scientific_notation_becomes_float():
+    # regression: yaml reads "1e-4" as a STRING (needs "1.0e-4" for
+    # float), and `from __future__ import annotations` makes the field
+    # type a string too, so coercion must resolve real type hints —
+    # otherwise the string reaches optax and `'1e-4' * param` raises.
+    cfg = config_cli(Cfg(), ["inner.lr=1e-4"])
+    assert isinstance(cfg.inner.lr, float) and cfg.inner.lr == 1e-4
+
+
+def test_cli_int_bool_tuple_coercion():
+    cfg = config_cli(Cfg(), ["inner.steps=5", "flag=true",
+                             "inner.sizes=[3,4,5]"])
+    assert cfg.inner.steps == 5 and cfg.flag is True
+    assert cfg.inner.sizes == (3, 4, 5)
+
+
+def test_merge_dict_strict_unknown_key():
+    with pytest.raises(KeyError):
+        merge_dict(Cfg(), {"inner": {"nope": 1}})
+    out = merge_dict(Cfg(), {"inner": {"nope": 1}}, strict=False)
+    assert out == Cfg()
+
+
+def test_yaml_file_merge(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("inner:\n  lr: 0.5\n  name: adamw\n")
+    cfg = config_cli(Cfg(), ["--cfg", str(p), "inner.steps", "7"])
+    assert cfg.inner.lr == 0.5
+    assert cfg.inner.name == "adamw"
+    assert cfg.inner.steps == 7

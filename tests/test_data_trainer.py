@@ -193,3 +193,22 @@ class TestLrFinder:
             batches * 3, min_lr=1e-5, max_lr=10.0)
         assert 1e-5 < res["suggestion"] < 10.0
         assert len(res["lrs"]) == len(res["losses"])
+
+
+def test_parallel_loader_matches_serial():
+    """num_workers>0 must yield the same batches in the same order as
+    the serial path (decode runs on a pool, assembly stays ordered)."""
+    from deeplearning_tpu.data.loader import DataLoader, MapSource
+
+    def fetch(i):
+        return {"x": np.full((3,), i, np.float32),
+                "label": np.asarray(i, np.int32)}
+
+    src = MapSource(37, fetch)
+    serial = DataLoader(src, 8, shuffle=True, seed=3)
+    pooled = DataLoader(src, 8, shuffle=True, seed=3, num_workers=4,
+                        lookahead=3)
+    for a, b in zip(serial, pooled):
+        np.testing.assert_array_equal(a["x"], b["x"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    assert len(list(iter(pooled))) == len(serial)

@@ -55,6 +55,21 @@ def test_mask_to_bbox_and_crop():
     assert crop.shape == (24, 24, 3)
 
 
+def test_crop_by_mask_resolution_mismatch():
+    """Stage-1 masks are predicted at a fixed size; the bbox must be
+    rescaled into image space, not applied in mask coordinates."""
+    img = np.zeros((128, 256, 3), np.float32)
+    img[64:96, 128:192] = 7.0          # object in image space
+    mask = np.zeros((64, 64), np.float32)
+    mask[32:48, 32:48] = 1.0           # same object in 64x64 mask space
+    crop = crop_by_mask(img, mask, pad_frac=0.0)
+    assert crop.shape == (32, 64, 3)   # 16/64 of 128, 16/64 of 256
+    assert (crop == 7.0).all()
+    # empty mask falls back to the FULL image, not the mask extent
+    full = crop_by_mask(img, np.zeros((64, 64)), pad_frac=0.0)
+    assert full.shape == img.shape
+
+
 def test_staged_mask_crop_pipeline(tmp_path):
     """Stage 1 writes masks from a (random-weight) U-Net head; stage 2's
     source crops by them; the retrieval model embeds the crops."""

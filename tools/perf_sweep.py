@@ -68,11 +68,13 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20):
     cost = compiled.cost_analysis()
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
-    state, metrics = step(state, data, rng)
+    # drive the ALREADY-compiled executable (re-calling step would pay a
+    # second identical XLA compile, minutes on TPU)
+    state, metrics = compiled(state, data, rng)
     float(metrics["loss"])  # D2H sync (block_until_ready unreliable here)
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, data, rng)
+        state, metrics = compiled(state, data, rng)
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
     mfu = step_flops / dt / peak_flops(jax.devices()[0]) * 100.0

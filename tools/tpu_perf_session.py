@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""One-shot TPU perf session: run EVERYTHING in a single completing
+process (the axon tunnel wedges if a TPU process is killed mid-compile,
+so no stage may be timeout-killed; results print incrementally with
+flush so partial progress survives a tunnel death).
+
+Stages:
+  1. health probe (fails fast if the tunnel is wedged)
+  2. ViT-B/16 train-step MFU: naive vs flash vs flash_hb attention
+  3. attention kernel microbench fwd+bwd at ViT + long-context shapes
+  4. Swin-B window-attention: fused kernel vs lax path
+
+Run: python tools/tpu_perf_session.py [--skip-train-steps]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    jnp.asarray(x).ravel()[0].astype(jnp.float32).item()
+
+
+def bench(fn, args, n=20, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    out = jax.tree.leaves(out)[0]
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / n
+
+
+def stage1_probe():
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    val = float(jnp.asarray(x @ x, jnp.float32)[0, 0])
+    assert val == 256.0, val
+    print(f"[probe] ok in {time.perf_counter() - t0:.1f}s "
+          f"device={jax.devices()[0].device_kind}", flush=True)
+
+
+def stage2_train_steps():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from perf_sweep import time_variant
+    from deeplearning_tpu.ops.attention import (flash_attn_adapter,
+                                                flash_hb_adapter)
+    results = {}
+    for name, fn in [("naive", None),
+                     ("flash_hb", flash_hb_adapter),
+                     ("flash", flash_attn_adapter)]:
+        try:
+            dt, mfu = time_variant(f"vit_train_{name}", 128, attn_fn=fn)
+            results[name] = mfu
+        except Exception as e:                       # noqa: BLE001
+            print(f"[train:{name}] FAILED: {e}", flush=True)
+    if results:
+        best = max(results, key=results.get)
+        print(f"[train] best attention for ViT-B/16 step: {best} "
+              f"({results[best]:.2f}% MFU)", flush=True)
+    return results
+
+
+def stage3_attn_micro():
+    from deeplearning_tpu.models.classification.vit import (
+        dot_product_attention)
+    from deeplearning_tpu.ops.pallas.flash_attention import (
+        flash_attention, flash_attention_hb)
+
+    def naive_bhnd(q, k, v):
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        return t(dot_product_attention(t(q), t(k), t(v)))
+
+    shapes = [(128, 12, 197, 64), (128, 16, 50, 80),
+              (8, 12, 1024, 64), (2, 12, 4096, 64), (1, 12, 8192, 64)]
+    variants = {"naive": naive_bhnd, "flash": flash_attention,
+                "flash_hb": flash_attention_hb}
+    for shape in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+                   for _ in range(3))
+        for mode in ("fwd", "bwd"):
+            row = {}
+            for name, fn in variants.items():
+                f = (jax.jit(fn) if mode == "fwd" else jax.jit(jax.grad(
+                    lambda q, k, v, _fn=fn: _fn(q, k, v)
+                    .astype(jnp.float32).sum(), argnums=(0, 1, 2))))
+                try:
+                    row[name] = bench(f, (q, k, v)) * 1e3
+                except Exception as e:               # noqa: BLE001
+                    print(f"[attn {shape} {mode} {name}] FAILED: {e}",
+                          flush=True)
+                    row[name] = float("nan")
+            ok = {k: v for k, v in row.items() if not np.isnan(v)}
+            best = min(ok, key=ok.get) if ok else "-"
+            cells = " ".join(f"{k}={v:.3f}ms" for k, v in row.items())
+            print(f"[attn {shape} {mode}] {cells} winner={best}",
+                  flush=True)
+
+
+def stage4_window():
+    from deeplearning_tpu.ops.pallas.window_attention import (
+        window_attention)
+
+    # Swin-B stage-1 training shape: 224/4=56 → 64 windows of 7²=49
+    # tokens, 4 heads d=32 (dim 128), batch 64 → BW=4096
+    bw, n, heads, d = 64 * 64, 49, 4, 32
+    rng = np.random.default_rng(0)
+    qkv = jnp.asarray(rng.normal(size=(bw, n, 3, heads, d)), jnp.bfloat16)
+    bias = jnp.asarray(rng.normal(size=(heads, n, n)), jnp.float32)
+
+    def lax_path(qkv, bias):
+        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)
+        k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
+        v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
+        s = jnp.einsum("bhnd,bhmd->bhnm", q * (d ** -0.5), k)
+        s = s + bias[None].astype(s.dtype)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhnm,bhmd->bhnd", p, v)
+        return jnp.moveaxis(o, 1, 2).reshape(bw, n, heads * d)
+
+    for name, fn in [("lax", lax_path), ("pallas", window_attention)]:
+        try:
+            dt = bench(jax.jit(fn), (qkv, bias)) * 1e3
+            print(f"[window fwd {name}] {dt:.3f}ms", flush=True)
+        except Exception as e:                       # noqa: BLE001
+            print(f"[window fwd {name}] FAILED: {e}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-train-steps", action="store_true")
+    ap.add_argument("--skip-micro", action="store_true")
+    args = ap.parse_args()
+    stage1_probe()
+    if not args.skip_micro:
+        stage3_attn_micro()
+        stage4_window()
+    if not args.skip_train_steps:
+        stage2_train_steps()
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,7 @@ class DataCfg:
     global_batch: int = 64
     val_rate: float = 0.2            # folder-mode train/val split
     num_workers: int = 8             # folder-mode decode threads
+    augment: str = "imagenet"        # imagenet | light | none
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,7 +122,8 @@ def main(argv=None) -> int:
                             image_size=cfg.data.image_size,
                             val_rate=cfg.data.val_rate,
                             num_workers=cfg.data.num_workers,
-                            seed=cfg.train.seed)
+                            seed=cfg.train.seed,
+                            augment=cfg.data.augment)
         loader, eval_loader, class_to_idx = build_classification_loaders(
             cfg.data.folder, lcfg, mesh=mesh,
             class_indices_path=(os.path.join(cfg.train.workdir,

@@ -251,6 +251,11 @@ def main(argv=None) -> int:
             images_dir=images_dir, records=records,
             class_names=class_names, image_size=size,
             max_gt=cfg.data.max_gt, augment=False)
+        if cfg.model.num_classes != len(class_names):
+            raise ValueError(
+                f"model.num_classes={cfg.model.num_classes} but "
+                f"{cfg.data.coco} has {len(class_names)} categories — "
+                "set model.num_classes to match")
         num_classes = len(class_names)
         order = np.random.default_rng(cfg.train.seed).permutation(
             len(aug_src))
