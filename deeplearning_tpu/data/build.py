@@ -72,9 +72,11 @@ def build_classification_loaders(
     n_proc = jax.process_count()
     val_paths = list(split["val_paths"])
     val_labels = list(split["val_labels"])
+    orig_len = len(val_paths)
     while val_paths and len(val_paths) % n_proc:
-        val_paths.append(val_paths[-1])
-        val_labels.append(val_labels[-1])
+        # round-robin distinct tail entries so no single image dominates
+        val_paths.append(val_paths[len(val_paths) % orig_len])
+        val_labels.append(val_labels[len(val_labels) % orig_len])
     val_batch = min(cfg.global_batch,
                     max(len(val_paths) // n_proc, 1) * n_proc)
     val = DataLoader(
@@ -99,7 +101,15 @@ def measure_throughput(loader: DataLoader, n_batches: int = 30,
 
     def cycle():
         while True:
-            yield from iter(loader)
+            got_any = False
+            for item in iter(loader):
+                got_any = True
+                yield item
+            if not got_any:
+                raise ValueError(
+                    "loader yielded zero batches (fewer images than one "
+                    "global batch under drop-last?) — cannot measure "
+                    "throughput")
 
     it = cycle()
     n = 0

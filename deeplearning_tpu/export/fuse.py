@@ -1,0 +1,100 @@
+"""Deploy-time Conv+BatchNorm folding (yolov5 utils/torch_utils.py:211
+``fuse_conv_and_bn`` analog) as a pure pytree transform.
+
+Folds each BatchNorm's inference affine into the preceding conv's kernel
+so the exported graph does one multiply less per channel and — more
+usefully — so fused weights can be exported to runtimes that expect
+conv-only graphs. The BN node is rewritten to an exact identity
+(mean=0, var=0, scale=sqrt(eps)) rather than removed, because flax
+module structure is static; applying the fused tree through the original
+model reproduces the unfused outputs bit-for-bit up to one rounding.
+
+Pairing is by the repo's naming convention (resnet.py, yolox.py,
+hrnet.py ConvBN): a sibling ``bnX`` folds into ``convX``; ``bn`` into
+``conv``; inside a ConvBN-style wrapper the children are literally
+``conv``/``bn``. Explicit (conv_path, bn_path) pairs override.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["fuse_conv_bn"]
+
+
+def _candidate_conv(bn_name: str, siblings) -> Optional[str]:
+    for conv_name in (bn_name.replace("bn", "conv"),
+                      bn_name.replace("_bn", "_conv"),
+                      "conv" + bn_name[2:] if bn_name.startswith("bn") else ""):
+        if conv_name and conv_name != bn_name and conv_name in siblings:
+            return conv_name
+    return None
+
+
+def _walk(params: Dict, stats: Dict, path: Tuple[str, ...],
+          found: List[Tuple[Tuple[str, ...], Tuple[str, ...]]]):
+    bn_names = [k for k, v in params.items()
+                if isinstance(v, dict) and "scale" in v
+                and k in stats and "mean" in stats[k]]
+    for bn in bn_names:
+        conv = _candidate_conv(bn, params)
+        if conv is not None and isinstance(params[conv], dict) \
+                and "kernel" in params[conv] \
+                and params[conv]["kernel"].ndim >= 2:
+            found.append((path + (conv,), path + (bn,)))
+    for key, value in params.items():
+        if isinstance(value, dict):
+            _walk(value, stats.get(key, {}), path + (key,), found)
+
+
+def _get(tree: Dict, path: Sequence[str]) -> Dict:
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def fuse_conv_bn(variables: Dict, *,
+                 pairs: Optional[Sequence[Tuple[Sequence[str],
+                                                Sequence[str]]]] = None,
+                 eps: float = 1e-5) -> Dict:
+    """Return new ``{"params", "batch_stats"}`` with every detected
+    (conv, bn) pair folded. Shapes and tree structure are unchanged, so
+    the result applies through the original module with ``train=False``.
+    """
+    import jax
+
+    params = jax.tree_util.tree_map(lambda x: x, variables["params"])
+    stats = jax.tree_util.tree_map(lambda x: x, variables["batch_stats"])
+    if pairs is None:
+        auto: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = []
+        _walk(params, stats, (), auto)
+        pairs = auto
+
+    for conv_path, bn_path in pairs:
+        conv = _get(params, conv_path)
+        bn = _get(params, bn_path)
+        st = _get(stats, bn_path)
+        gamma = jnp.asarray(bn["scale"], jnp.float32)
+        beta = jnp.asarray(bn["bias"], jnp.float32)
+        mean = jnp.asarray(st["mean"], jnp.float32)
+        var = jnp.asarray(st["var"], jnp.float32)
+        g = gamma * jax.lax.rsqrt(var + eps)
+
+        kernel = jnp.asarray(conv["kernel"])
+        conv["kernel"] = (kernel.astype(jnp.float32) * g).astype(kernel.dtype)
+        bias = jnp.asarray(conv.get("bias", jnp.zeros_like(mean)), jnp.float32)
+        fused_bias = (bias - mean) * g + beta
+        if "bias" in conv:
+            conv["bias"] = fused_bias.astype(kernel.dtype)
+            bn["bias"] = jnp.zeros_like(beta)
+        else:
+            # conv has no bias param; carry the shift in the identity BN
+            bn["bias"] = fused_bias
+        # (z - 0) / sqrt(0 + eps) * sqrt(eps) == z exactly in real math
+        bn["scale"] = jnp.full_like(gamma, jnp.sqrt(jnp.float32(eps)))
+        st["mean"] = jnp.zeros_like(mean)
+        st["var"] = jnp.zeros_like(var)
+
+    return {"params": params, "batch_stats": stats}

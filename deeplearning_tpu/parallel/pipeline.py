@@ -124,6 +124,15 @@ def pack_stages(params_list) -> Tuple[jax.Array, list]:
         leaves, treedef = jax.tree.flatten(p)
         shapes = [l.shape for l in leaves]
         dtypes = [l.dtype for l in leaves]
+        for d in dtypes:
+            # the packed row is f32; wider/integer leaves would silently
+            # lose bits on the round trip
+            if not (jnp.issubdtype(d, jnp.floating)
+                    and jnp.dtype(d).itemsize <= 4):
+                raise TypeError(
+                    f"pack_stages supports float leaves of <=32 bits, got "
+                    f"{d}; keep non-float state out of the packed stage "
+                    f"params")
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         offs = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
         flat = (jnp.concatenate([jnp.ravel(l).astype(jnp.float32)

@@ -1,0 +1,109 @@
+"""Torch checkpoint → flax variables converter.
+
+The reference ships weight converters in both directions
+(classification/efficientNet/trans_weights_to_pytorch.py,
+deep_stereo/.../trans_weight_to_pytorch.py) plus a partial/renamed
+state-dict loading tour (others/load_weights_test/load_weights.py). This
+module is the TPU-era analog: it turns a torch ``state_dict`` (dotted
+names, OIHW conv kernels, (out,in) linear weights) into a flax variables
+tree ({"params": ..., "batch_stats": ...}) with the layout transposes the
+two frameworks disagree on, so reference-zoo ``.pth`` files can seed our
+models via ``core.checkpoint.surgical_load``.
+
+Layout rules applied per tensor:
+- conv ``weight`` (O,I,kH,kW)  -> ``kernel`` (kH,kW,I,O)
+- linear ``weight`` (out,in)   -> ``kernel`` (in,out)
+- norm ``weight``              -> ``scale``  (unchanged shape)
+- ``running_mean``/``running_var`` -> batch_stats ``mean``/``var``
+- ``num_batches_tracked``      -> dropped
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["torch_to_flax", "load_torch_checkpoint"]
+
+_BN_STATS = {"running_mean": "mean", "running_var": "var"}
+_NORM_HINTS = ("bn", "norm", "downsample.1")
+
+
+def _is_norm_weight(torch_key: str, arr: np.ndarray,
+                    state: Mapping[str, Any]) -> bool:
+    """A 1-D ``weight`` is a norm scale iff the module also has running
+    stats, or its name says so (LayerNorm has no running stats)."""
+    if arr.ndim != 1:
+        return False
+    stem = torch_key.rsplit(".", 1)[0]
+    if f"{stem}.running_mean" in state:
+        return True
+    return any(h in stem.lower() for h in _NORM_HINTS)
+
+
+def _convert(torch_key: str, arr: np.ndarray,
+             state: Mapping[str, Any]) -> Tuple[str, np.ndarray, str]:
+    """-> (flax_leaf_name, converted_array, collection)."""
+    leaf = torch_key.rsplit(".", 1)[-1]
+    if leaf in _BN_STATS:
+        return _BN_STATS[leaf], arr, "batch_stats"
+    if leaf == "weight":
+        if arr.ndim == 4:                       # conv OIHW -> HWIO
+            return "kernel", arr.transpose(2, 3, 1, 0), "params"
+        if arr.ndim == 3:                       # conv1d OIW -> WIO
+            return "kernel", arr.transpose(2, 1, 0), "params"
+        if arr.ndim == 2:                       # linear (out,in) -> (in,out)
+            return "kernel", arr.transpose(1, 0), "params"
+        if _is_norm_weight(torch_key, arr, state):
+            return "scale", arr, "params"
+        return "kernel", arr, "params"
+    return leaf, arr, "params"
+
+
+def torch_to_flax(
+    state_dict: Mapping[str, Any],
+    rename: Optional[Callable[[str], Optional[str]]] = None,
+) -> Dict[str, Dict]:
+    """Convert a torch ``state_dict`` to a flax variables tree.
+
+    ``rename`` maps each torch module path (dots already split off the
+    leaf) to the flax module path ("a/b/c"); return None to drop the
+    entry. Default: dots become path separators unchanged, so converted
+    trees line up with models whose submodule names mirror the torch
+    implementation (the surgical_load name-mapping hook covers the rest).
+    """
+    out: Dict[str, Dict] = {"params": {}, "batch_stats": {}}
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        arr = np.asarray(
+            value.detach().cpu().numpy() if hasattr(value, "detach")
+            else value)
+        stem = key.rsplit(".", 1)[0] if "." in key else ""
+        if rename is not None:
+            stem = rename(stem)
+            if stem is None:
+                continue
+        leaf, arr, col = _convert(key, arr, state_dict)
+        node = out[col]
+        for part in (p for p in stem.split(".") if p):
+            node = node.setdefault(part, {})
+        node[leaf] = arr
+    return {k: v for k, v in out.items() if v}
+
+
+def load_torch_checkpoint(path: str, **kw) -> Dict[str, Dict]:
+    """Read a ``.pth``/``.pt`` file (CPU map) and convert. Accepts either a
+    bare state_dict or the common {"model"|"state_dict": ...} wrappers."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    for wrapper in ("model", "state_dict", "model_state_dict"):
+        if isinstance(obj, dict) and wrapper in obj and isinstance(
+                obj[wrapper], dict):
+            obj = obj[wrapper]
+            break
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    return torch_to_flax(obj, **kw)

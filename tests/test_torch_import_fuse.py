@@ -1,0 +1,109 @@
+"""Torch-checkpoint import + conv/BN fusion.
+
+Covers the reference's weight-converter scripts
+(classification/efficientNet/trans_weights_to_pytorch.py,
+others/load_weights_test/load_weights.py) and yolov5's
+fuse_conv_and_bn (utils/torch_utils.py:211)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+torch = pytest.importorskip("torch")
+
+from deeplearning_tpu.export.fuse import fuse_conv_bn
+from deeplearning_tpu.utils.torch_import import (load_torch_checkpoint,
+                                                 torch_to_flax)
+
+
+class _TorchNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(3, 8, 3, padding=1)
+        self.bn = torch.nn.BatchNorm2d(8)
+        self.fc = torch.nn.Linear(8, 4)
+
+    def forward(self, x):
+        x = torch.relu(self.bn(self.conv(x)))
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+class _FlaxNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(8, (3, 3), padding=[(1, 1), (1, 1)], name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-5,
+                         name="bn")(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(4, name="fc")(x)
+
+
+def _make_torch_net():
+    torch.manual_seed(0)
+    net = _TorchNet()
+    with torch.no_grad():
+        net.bn.running_mean.normal_(0.0, 0.5)
+        net.bn.running_var.uniform_(0.5, 2.0)
+        net.bn.weight.normal_(1.0, 0.2)
+        net.bn.bias.normal_(0.0, 0.2)
+    return net.eval()
+
+
+def test_torch_to_flax_forward_parity():
+    net = _make_torch_net()
+    variables = torch_to_flax(net.state_dict())
+    assert set(variables) == {"params", "batch_stats"}
+    assert "num_batches_tracked" not in str(
+        jax.tree_util.tree_structure(variables))
+
+    x = np.random.default_rng(0).normal(size=(2, 3, 16, 16)).astype("f4")
+    with torch.no_grad():
+        want = net(torch.from_numpy(x)).numpy()
+    got = _FlaxNet().apply(
+        jax.tree_util.tree_map(jnp.asarray, variables),
+        jnp.asarray(x.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_load_torch_checkpoint_wrappers(tmp_path):
+    net = _make_torch_net()
+    path = tmp_path / "ckpt.pth"
+    torch.save({"model": net.state_dict(), "epoch": 3}, path)
+    variables = load_torch_checkpoint(str(path))
+    assert variables["params"]["conv"]["kernel"].shape == (3, 3, 3, 8)
+    assert variables["params"]["fc"]["kernel"].shape == (8, 4)
+    assert variables["batch_stats"]["bn"]["mean"].shape == (8,)
+
+
+def test_fuse_conv_bn_resnet18_parity():
+    from deeplearning_tpu.core.registry import MODELS
+
+    model = MODELS.build("resnet18", num_classes=10, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                    jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    # make the running stats non-trivial so fusion is actually exercised
+    _, updated = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    keys = iter(jax.random.split(jax.random.key(1), 10_000))
+    stats = jax.tree_util.tree_map(
+        lambda s: s + 0.1 * jax.random.uniform(next(keys), s.shape),
+        updated["batch_stats"])
+    variables = {"params": variables["params"], "batch_stats": stats}
+
+    want = model.apply(variables, x, train=False)
+    fused = fuse_conv_bn(variables)
+    got = model.apply(fused, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+    # every BN with a matching conv was rewritten to the identity form
+    n_fused = sum(
+        1 for path, leaf in jax.tree_util.tree_leaves_with_path(
+            fused["batch_stats"])
+        if path[-1].key == "var" and float(jnp.abs(leaf).max()) == 0.0)
+    assert n_fused >= 20  # resnet18: stem + 8 blocks * 2 + downsamples

@@ -79,9 +79,6 @@ def main():
             if args.mode == "fwd":
                 f = jax.jit(fn)
             else:
-                if name == "flash_hb":   # fwd-only variant
-                    row[name] = float("nan")
-                    continue
                 f = jax.jit(jax.grad(
                     lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
                     argnums=(0, 1, 2)))
@@ -91,8 +88,8 @@ def main():
             except Exception as e:                 # noqa: BLE001
                 print(f"  {name} failed on {shape}: {e}", file=sys.stderr)
                 row[name] = float("nan")
-        best = min((v, k) for k, v in row.items()
-                   if not np.isnan(v))[1]
+        ok = [(v, k) for k, v in row.items() if not np.isnan(v)]
+        best = min(ok)[1] if ok else "-"
         cells = " | ".join(f"{row[k]:.3f}ms" for k in variants)
         print(f"| {shape} | {args.mode} | {cells} | {best} |", flush=True)
 
