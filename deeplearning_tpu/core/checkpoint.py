@@ -141,6 +141,72 @@ def surgical_load(
     return _unflatten(flat_params)
 
 
+def resize_vit_pos_embed(path: str, value: np.ndarray,
+                         new_shape: tuple) -> Optional[np.ndarray]:
+    """``resize_fn`` for ViT ``pos_embed`` (1, 1+N, C): bicubic-free 2-D
+    bilinear resize of the patch-grid part, cls token kept. The swin
+    load_pretrained absolute_pos_embed interpolation analog
+    (swin utils/torch_utils.py:186-201)."""
+    if "pos_embed" not in path or value.ndim != 3 or len(new_shape) != 3:
+        return None
+    n_old, n_new = value.shape[1] - 1, new_shape[1] - 1
+    g_old, g_new = int(round(n_old ** 0.5)), int(round(n_new ** 0.5))
+    if g_old * g_old != n_old or g_new * g_new != n_new:
+        return None
+    cls, grid = value[:, :1], value[:, 1:]
+    grid = grid.reshape(g_old, g_old, -1)
+    grid = _bilinear_resize(grid, g_new, g_new)
+    return np.concatenate(
+        [cls, grid.reshape(1, g_new * g_new, -1)], axis=1)
+
+
+def resize_relative_position_bias(path: str, value: np.ndarray,
+                                  new_shape: tuple) -> Optional[np.ndarray]:
+    """``resize_fn`` for swin ``relative_position_bias_table``
+    ((2w-1)^2, H): bilinear resize over the (2w-1, 2w-1) offset grid when
+    the window size changes (swin utils/torch_utils.py:160-185)."""
+    if "relative_position_bias" not in path or value.ndim != 2 \
+            or len(new_shape) != 2 or value.shape[1] != new_shape[1]:
+        return None
+    s_old = int(round(value.shape[0] ** 0.5))
+    s_new = int(round(new_shape[0] ** 0.5))
+    if s_old * s_old != value.shape[0] or s_new * s_new != new_shape[0]:
+        return None
+    grid = value.reshape(s_old, s_old, -1)
+    grid = _bilinear_resize(grid, s_new, s_new)
+    return grid.reshape(s_new * s_new, -1)
+
+
+def default_resize_fn(path: str, value: np.ndarray,
+                      new_shape: tuple) -> Optional[np.ndarray]:
+    """Chain of the built-in interpolators; pass to surgical_load as
+    ``resize_fn=default_resize_fn`` for ViT/Swin size transfers."""
+    for fn in (resize_vit_pos_embed, resize_relative_position_bias):
+        out = fn(path, value, new_shape)
+        if out is not None:
+            return out
+    return None
+
+
+def _bilinear_resize(grid: np.ndarray, h: int, w: int) -> np.ndarray:
+    """(H, W, C) -> (h, w, C) bilinear, align_corners=True semantics (what
+    torch F.interpolate uses in the swin loader for these tables)."""
+    h_old, w_old = grid.shape[:2]
+    if (h_old, w_old) == (h, w):
+        return grid
+    ys = np.linspace(0, h_old - 1, h)
+    xs = np.linspace(0, w_old - 1, w)
+    y0 = np.clip(np.floor(ys).astype(int), 0, h_old - 1)
+    y1 = np.clip(y0 + 1, 0, h_old - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w_old - 1)
+    x1 = np.clip(x0 + 1, 0, w_old - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    top = grid[y0][:, x0] * (1 - wx) + grid[y0][:, x1] * wx
+    bot = grid[y1][:, x0] * (1 - wx) + grid[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
 def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for k, v in tree.items():

@@ -167,3 +167,49 @@ class TensorBoardWriter:
     def close(self) -> None:
         if self._writer:
             self._writer.close()
+
+
+class CsvLogger:
+    """Append-per-step CSV metrics file, process-0 only — the yolov5
+    pluggable-loggers csv path (utils/loggers/__init__.py:17-27,
+    results.csv). Columns are fixed on first write; later dicts may omit
+    keys (blank cell) but new keys are ignored with a warning."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path if (path and is_main_process()) else None
+        self._columns: Optional[list] = None
+
+    def log(self, step: int, metrics: Dict[str, Any]) -> None:
+        if self._path is None:
+            return
+        import csv
+        import os
+        row = {"step": step, **{k: _scalar(v) for k, v in metrics.items()}}
+        write_header = False
+        if self._columns is None:
+            os.makedirs(os.path.dirname(os.path.abspath(self._path)),
+                        exist_ok=True)
+            # resumed run: adopt the existing file's header instead of
+            # appending a duplicate header row mid-file
+            if os.path.exists(self._path) and os.path.getsize(self._path):
+                with open(self._path, newline="") as f:
+                    self._columns = next(csv.reader(f), None)
+            if self._columns is None:
+                self._columns = list(row)
+                write_header = True
+        extra = set(row) - set(self._columns)
+        if extra:
+            create_logger().warning(
+                f"CsvLogger: ignoring new columns {sorted(extra)}")
+        with open(self._path, "a", newline="") as f:
+            w = csv.DictWriter(f, self._columns, extrasaction="ignore")
+            if write_header:
+                w.writeheader()
+            w.writerow(row)
+
+
+def _scalar(v: Any) -> Any:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v

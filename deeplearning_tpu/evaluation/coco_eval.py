@@ -279,14 +279,9 @@ class CocoEvaluator:
                         pr = tp_cum[ti] / np.maximum(
                             tp_cum[ti] + fp_cum[ti], 1e-9)
                         recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0
-                        # precision envelope (monotone decreasing)
-                        for i in range(len(pr) - 1, 0, -1):
-                            pr[i - 1] = max(pr[i - 1], pr[i])
-                        inds = np.searchsorted(rc, RECALL_THRS, side="left")
-                        q = np.zeros(len(RECALL_THRS))
-                        valid = inds < len(pr)
-                        q[valid] = pr[inds[valid]]
-                        precision[ti, :, ki, ai, mi] = q
+                        from .metrics import interp_precision_at_recall
+                        precision[ti, :, ki, ai, mi] = \
+                            interp_precision_at_recall(pr, rc, RECALL_THRS)
         return {"precision": precision, "recall": recall}
 
     # --------------------------------------------------------- summarize

@@ -64,3 +64,41 @@ def dice_counts(probs: jax.Array, onehot: jax.Array
     inter = jnp.sum(probs * onehot)
     denom = jnp.sum(probs) + jnp.sum(onehot)
     return 2 * inter, denom
+
+
+def interp_precision_at_recall(precision: np.ndarray, recall: np.ndarray,
+                               rec_points: np.ndarray) -> np.ndarray:
+    """COCO-convention interpolated precision: envelope (monotone
+    non-increasing right-to-left) then left-searchsorted sampling at
+    ``rec_points``. Single source of truth shared by coco_eval.py
+    accumulate() and precision_recall_curve()."""
+    pr = np.asarray(precision, np.float64)
+    envelope = np.maximum.accumulate(pr[::-1])[::-1]
+    idx = np.searchsorted(recall, rec_points, side="left")
+    out = np.zeros(len(rec_points))
+    valid = idx < len(envelope)
+    out[valid] = envelope[idx[valid]]
+    return out
+
+
+def precision_recall_curve(scores: np.ndarray, is_tp: np.ndarray,
+                           n_gt: int) -> Dict[str, np.ndarray]:
+    """Single-class PR curve + AP from scored detections (yolov5
+    utils/metrics.py ap_per_class surface, host-side).
+
+    scores: (N,) detection confidences; is_tp: (N,) bool, whether each
+    detection matched an unmatched gt at the working IoU; n_gt: number of
+    ground-truth instances. Returns precision/recall arrays sorted by
+    descending confidence plus 101-point-interpolated AP (the COCO
+    convention, same interpolation as evaluation/coco_eval.py)."""
+    order = np.argsort(-np.asarray(scores, np.float64))
+    tp = np.asarray(is_tp, np.float64)[order]
+    fp = 1.0 - tp
+    tp_cum, fp_cum = np.cumsum(tp), np.cumsum(fp)
+    recall = tp_cum / max(n_gt, 1)
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+    rec_points = np.linspace(0.0, 1.0, 101)
+    ap = float(np.mean(interp_precision_at_recall(
+        precision, recall, rec_points))) if len(tp) else 0.0
+    return {"precision": precision, "recall": recall,
+            "scores": np.asarray(scores, np.float64)[order], "ap": ap}

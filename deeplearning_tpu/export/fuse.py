@@ -58,11 +58,16 @@ def _get(tree: Dict, path: Sequence[str]) -> Dict:
 def fuse_conv_bn(variables: Dict, *,
                  pairs: Optional[Sequence[Tuple[Sequence[str],
                                                 Sequence[str]]]] = None,
-                 eps: float = 1e-5) -> Dict:
+                 eps=1e-5) -> Dict:
     """Return new ``{"params", "batch_stats"}`` with every detected
     (conv, bn) pair folded. Shapes and tree structure are unchanged, so
     the result applies through the original module with ``train=False``.
-    """
+
+    ``eps`` MUST equal each BatchNorm module's epsilon — both the folded
+    multiplier and the identity-BN rewrite depend on it, so a mismatch
+    (e.g. fusing an eps=1e-3 model with the 1e-5 default) mis-scales
+    every fused layer. Pass a callable ``eps('/'.join(bn_path)) -> float``
+    for models mixing epsilons."""
     import jax
 
     params = jax.tree_util.tree_map(lambda x: x, variables["params"])
@@ -73,6 +78,7 @@ def fuse_conv_bn(variables: Dict, *,
         pairs = auto
 
     for conv_path, bn_path in pairs:
+        bn_eps = eps("/".join(bn_path)) if callable(eps) else float(eps)
         conv = _get(params, conv_path)
         bn = _get(params, bn_path)
         st = _get(stats, bn_path)
@@ -80,7 +86,7 @@ def fuse_conv_bn(variables: Dict, *,
         beta = jnp.asarray(bn["bias"], jnp.float32)
         mean = jnp.asarray(st["mean"], jnp.float32)
         var = jnp.asarray(st["var"], jnp.float32)
-        g = gamma * jax.lax.rsqrt(var + eps)
+        g = gamma * jax.lax.rsqrt(var + bn_eps)
 
         kernel = jnp.asarray(conv["kernel"])
         conv["kernel"] = (kernel.astype(jnp.float32) * g).astype(kernel.dtype)
@@ -93,7 +99,7 @@ def fuse_conv_bn(variables: Dict, *,
             # conv has no bias param; carry the shift in the identity BN
             bn["bias"] = fused_bias
         # (z - 0) / sqrt(0 + eps) * sqrt(eps) == z exactly in real math
-        bn["scale"] = jnp.full_like(gamma, jnp.sqrt(jnp.float32(eps)))
+        bn["scale"] = jnp.full_like(gamma, jnp.sqrt(jnp.float32(bn_eps)))
         st["mean"] = jnp.zeros_like(mean)
         st["var"] = jnp.zeros_like(var)
 

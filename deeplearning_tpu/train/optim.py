@@ -74,12 +74,43 @@ def lars(schedule, momentum: float = 0.9, weight_decay: float = 0.0,
         trust_coefficient=trust_coefficient, momentum=momentum)
 
 
+def freeze_mask(params: Any, frozen: Sequence[str]) -> Any:
+    """True where the param path matches a frozen pattern. The reference
+    freezes via requires_grad=False — backbone freezing in fasterRcnn
+    change_backbone_with*.py, staged fine-tuning in TransFG — and via
+    FrozenBatchNorm2d (fasterRcnn/models/backbone/resnet50_fpn.py:5).
+    Here the same effect is an optax mask that zeroes the updates; for
+    frozen BN also run the layer with use_running_average so the stats
+    stay put.
+
+    Patterns match whole '/'-separated path components (possibly
+    multi-segment, e.g. "backbone/conv1"), so freeze=("blocks_1",) does
+    NOT also catch blocks_10/blocks_11 — the same boundary rule yolov5's
+    freeze list applies by matching 'model.{x}.' with the trailing dot."""
+    paths = tree_paths(params)
+
+    def match(path: str) -> bool:
+        padded = f"/{path.lower()}/"
+        return any(f"/{p.lower().strip('/')}/" in padded for p in frozen)
+    return jax.tree.map(lambda path, _: match(path), paths, params)
+
+
 def build_optimizer(name: str, schedule, clip_grad_norm: Optional[float] = None,
-                    params: Any = None, **kwargs) -> optax.GradientTransformation:
+                    params: Any = None,
+                    freeze: Optional[Sequence[str]] = None,
+                    **kwargs) -> optax.GradientTransformation:
     """Optimizer chain with optional global-norm clipping in front (the
     reference clips before step inside its AMP scaler,
-    swin utils/torch_utils.py:303-318)."""
+    swin utils/torch_utils.py:303-318) and optional parameter freezing
+    (path-substring patterns, e.g. freeze=("patch_embed", "blocks_0"))."""
     tx = OPTIMIZERS.build(name, schedule, params=params, **kwargs)
     if clip_grad_norm and clip_grad_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(clip_grad_norm), tx)
+    if freeze:
+        if params is None:
+            raise ValueError("freeze patterns require params to build the mask")
+        # zero the FINAL updates (not the grads): decoupled weight decay
+        # would otherwise still move frozen params
+        tx = optax.chain(
+            tx, optax.masked(optax.set_to_zero(), freeze_mask(params, freeze)))
     return tx

@@ -84,3 +84,35 @@ def trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def model_info(model, *example_args, train: bool = False,
+               tabulate: bool = False, **example_kw) -> Dict[str, float]:
+    """Params / FLOPs / activation summary for a flax model — the
+    get_model_info / model_info surface (yolov5 utils/torch_utils.py:236,
+    YOLOX yolox/utils/model_utils.py, vision_transformer/flops.py).
+
+    FLOPs come from XLA's compiled cost analysis of the forward (so
+    fusion is reflected, like thop/fvcore count the traced graph). Set
+    ``tabulate=True`` to also return flax's per-layer table string."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    variables = model.init(jax.random.key(0), *example_args,
+                           train=train, **example_kw)
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree.leaves(variables["params"]))
+    flops = compiled_flops(
+        lambda v, *a: model.apply(v, *a, train=train, **example_kw),
+        variables, *example_args)
+    info: Dict[str, float] = {
+        "params_m": n_params / 1e6,
+        "gflops": flops / 1e9,
+    }
+    if tabulate:
+        import flax.linen as nn
+        info["table"] = nn.tabulate(
+            model, jax.random.key(0),
+            compute_flops=False, compute_vjp_flops=False)(
+            *example_args, train=train, **example_kw)
+    return info

@@ -53,8 +53,14 @@ def _convert(torch_key: str, arr: np.ndarray,
             return "kernel", arr.transpose(2, 3, 1, 0), "params"
         if arr.ndim == 3:                       # conv1d OIW -> WIO
             return "kernel", arr.transpose(2, 1, 0), "params"
-        if arr.ndim == 2:                       # linear (out,in) -> (in,out)
-            return "kernel", arr.transpose(1, 0), "params"
+        if arr.ndim == 2:
+            # nn.Embedding stays (V, C) and flax calls it "embedding";
+            # detected by module name since torch stores both as "weight"
+            stem_last = torch_key.rsplit(".", 2)[-2] if "." in torch_key \
+                else ""
+            if "embed" in stem_last.lower():
+                return "embedding", arr, "params"
+            return "kernel", arr.transpose(1, 0), "params"  # linear
         if _is_norm_weight(torch_key, arr, state):
             return "scale", arr, "params"
         return "kernel", arr, "params"

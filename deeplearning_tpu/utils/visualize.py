@@ -108,12 +108,37 @@ def confusion_matrix_figure(matrix: np.ndarray,
     return fig
 
 
+def pr_curve_figure(curves):
+    """Overlay per-class PR curves (yolov5 utils/metrics.py plot_pr_curve
+    surface). ``curves``: {name: {"precision", "recall", "ap"}} as
+    produced by evaluation.metrics.precision_recall_curve. Returns a
+    matplotlib figure or None."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    fig, ax = plt.subplots(figsize=(6, 6))
+    for name, c in curves.items():
+        ax.plot(c["recall"], c["precision"],
+                label=f"{name} AP={c['ap']:.3f}")
+    ax.set_xlabel("recall")
+    ax.set_ylabel("precision")
+    ax.set_xlim(0, 1)
+    ax.set_ylim(0, 1.05)
+    ax.legend(loc="lower left", fontsize=8)
+    fig.tight_layout()
+    return fig
+
+
 def embedding_projection_figure(embeddings: np.ndarray,
-                                labels: Sequence[int]):
-    """2-D PCA scatter of embeddings colored by label — the SupCon
-    t-SNE.py visualization surface (PCA stands in for t-SNE: sklearn is
-    not a dependency; the plot's purpose — eyeballing cluster structure —
-    is served). Returns a matplotlib figure or None."""
+                                labels: Sequence[int],
+                                method: str = "pca"):
+    """2-D scatter of embeddings colored by label — the SupCon t-SNE.py
+    visualization surface. method: "pca" (no extra deps) or "tsne"
+    (sklearn, falling back to PCA if unavailable). Returns a matplotlib
+    figure or None."""
     try:
         import matplotlib
         matplotlib.use("Agg")
@@ -121,13 +146,25 @@ def embedding_projection_figure(embeddings: np.ndarray,
     except ImportError:
         return None
     x = np.asarray(embeddings, np.float64)
-    x = x - x.mean(0)
-    _, _, vt = np.linalg.svd(x, full_matrices=False)
-    proj = x @ vt[:2].T
+    proj = None
+    if method == "tsne" and len(x) >= 5:
+        try:
+            from sklearn.manifold import TSNE
+            proj = TSNE(n_components=2, init="pca",
+                        perplexity=min(30.0, max(2.0, len(x) / 4 - 1))
+                        ).fit_transform(x)
+        except (ImportError, ValueError):   # no sklearn / tiny n_samples
+            proj = None
+    if method == "tsne" and proj is None:
+        method = "pca"
+    if proj is None:
+        x = x - x.mean(0)
+        _, _, vt = np.linalg.svd(x, full_matrices=False)
+        proj = x @ vt[:2].T
     fig, ax = plt.subplots(figsize=(6, 6))
     sc = ax.scatter(proj[:, 0], proj[:, 1], c=np.asarray(labels),
                     cmap="tab10", s=12)
     fig.colorbar(sc, ax=ax, label="class")
-    ax.set_title("embedding projection (PCA)")
+    ax.set_title(f"embedding projection ({method.upper()})")
     fig.tight_layout()
     return fig

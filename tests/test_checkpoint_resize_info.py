@@ -1,0 +1,91 @@
+"""Pos-embed / relative-position-bias interpolation, model_info, CsvLogger.
+
+References: swin utils/torch_utils.py:143-231 load_pretrained (bias-table
+and absolute-pos-embed interpolation), yolov5 utils/torch_utils.py:236
+model_info, yolov5 utils/loggers (results.csv)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning_tpu.core.checkpoint import (default_resize_fn,
+                                              resize_relative_position_bias,
+                                              resize_vit_pos_embed,
+                                              surgical_load)
+from deeplearning_tpu.core.logging import CsvLogger
+
+
+class TestResize:
+    def test_pos_embed_resize_exact_on_constant(self):
+        value = np.ones((1, 1 + 16, 8), np.float32)  # 4x4 grid
+        out = resize_vit_pos_embed("pos_embed", value, (1, 1 + 49, 8))
+        assert out.shape == (1, 50, 8)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_pos_embed_resize_preserves_linear_ramp(self):
+        # bilinear with align_corners reproduces a linear field exactly
+        g = 6
+        ys = np.arange(g, dtype=np.float32)
+        grid = np.broadcast_to(ys[:, None, None], (g, g, 3))
+        value = np.concatenate(
+            [np.zeros((1, 1, 3), np.float32),
+             grid.reshape(1, g * g, 3)], axis=1)
+        out = resize_vit_pos_embed("pos_embed", value, (1, 1 + 121, 3))
+        new_grid = out[0, 1:].reshape(11, 11, 3)
+        want = np.linspace(0, g - 1, 11, dtype=np.float32)
+        np.testing.assert_allclose(new_grid[:, 0, 0], want, atol=1e-5)
+        np.testing.assert_allclose(out[0, 0], 0.0)  # cls untouched
+
+    def test_relative_position_bias_resize(self):
+        value = np.random.default_rng(0).normal(
+            size=(13 * 13, 4)).astype(np.float32)  # window 7 -> 2w-1=13
+        out = resize_relative_position_bias(
+            "layers_0/blocks_0/attn/relative_position_bias_table",
+            value, (23 * 23, 4))                   # window 12
+        assert out.shape == (23 * 23, 4)
+        # corners are fixed points under align_corners resize
+        np.testing.assert_allclose(
+            out.reshape(23, 23, 4)[0, 0], value.reshape(13, 13, 4)[0, 0],
+            atol=1e-5)
+
+    def test_surgical_load_with_default_resize(self):
+        params = {"pos_embed": np.zeros((1, 50, 8), np.float32),
+                  "other": np.zeros((3,), np.float32)}
+        pre = {"pos_embed": np.ones((1, 17, 8), np.float32),
+               "other": np.array([1., 2., 3.], np.float32)}
+        out = surgical_load(params, pre, resize_fn=default_resize_fn)
+        assert out["pos_embed"].shape == (1, 50, 8)
+        np.testing.assert_allclose(out["pos_embed"], 1.0)
+        np.testing.assert_allclose(out["other"], [1., 2., 3.])
+
+
+class TestModelInfo:
+    def test_vit_tiny_counts(self):
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.utils.profiling import model_info
+
+        model = MODELS.build("vit_base_patch16_224", num_classes=10,
+                             img_size=32, patch_size=8, embed_dim=64,
+                             depth=2, num_heads=4, dtype=jnp.float32)
+        info = model_info(model, jnp.zeros((1, 32, 32, 3)))
+        assert 0.05 < info["params_m"] < 1.0
+        assert info["gflops"] > 0.001
+
+
+class TestCsvLogger:
+    def test_roundtrip(self, tmp_path):
+        path = tmp_path / "results.csv"
+        log = CsvLogger(str(path))
+        log.log(1, {"loss": 2.0, "acc": 0.1})
+        log.log(2, {"loss": 1.0, "acc": 0.5, "new_col": 9})
+        lines = path.read_text().strip().splitlines()
+        assert lines[0] == "step,loss,acc"
+        assert lines[1] == "1,2.0,0.1"
+        assert lines[2].startswith("2,1.0,0.5")
+
+    def test_resume_does_not_duplicate_header(self, tmp_path):
+        path = tmp_path / "results.csv"
+        CsvLogger(str(path)).log(1, {"loss": 2.0})
+        log2 = CsvLogger(str(path))   # fresh instance = restarted run
+        log2.log(2, {"loss": 1.0})
+        lines = path.read_text().strip().splitlines()
+        assert lines == ["step,loss", "1,2.0", "2,1.0"]

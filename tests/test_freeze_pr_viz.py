@@ -1,0 +1,96 @@
+"""Parameter freezing, PR curves, t-SNE projection.
+
+References: fasterRcnn change_backbone_with*.py (backbone freezing),
+yolov5 utils/metrics.py (ap_per_class / plot_pr_curve),
+self-supervised/SupCon t-SNE.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_tpu.evaluation.metrics import precision_recall_curve
+from deeplearning_tpu.train.optim import build_optimizer, freeze_mask
+from deeplearning_tpu.train.schedules import build_schedule
+from deeplearning_tpu.utils.visualize import (embedding_projection_figure,
+                                              pr_curve_figure)
+
+
+class TestFreeze:
+    def _params(self):
+        return {
+            "backbone": {"conv1": {"kernel": jnp.ones((3, 3, 4, 8))}},
+            "head": {"fc": {"kernel": jnp.ones((8, 2)),
+                            "bias": jnp.zeros((2,))}},
+        }
+
+    def test_freeze_mask_matches_patterns(self):
+        mask = freeze_mask(self._params(), ("backbone",))
+        assert mask["backbone"]["conv1"]["kernel"] is True
+        assert mask["head"]["fc"]["kernel"] is False
+
+    def test_freeze_mask_component_boundaries(self):
+        params = {f"blocks_{i}": {"kernel": jnp.ones((2, 2))}
+                  for i in (1, 10, 11)}
+        mask = freeze_mask(params, ("blocks_1",))
+        assert mask["blocks_1"]["kernel"] is True
+        assert mask["blocks_10"]["kernel"] is False
+        assert mask["blocks_11"]["kernel"] is False
+        # multi-segment patterns still work
+        mask2 = freeze_mask(self._params(), ("backbone/conv1",))
+        assert mask2["backbone"]["conv1"]["kernel"] is True
+
+    def test_frozen_params_do_not_move_under_adamw_decay(self):
+        params = self._params()
+        tx = build_optimizer("adamw", build_schedule("constant",
+                                                     base_lr=0.1),
+                             params=params, weight_decay=0.5,
+                             freeze=("backbone",))
+        state = tx.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = tx.update(grads, state, params)
+        assert float(jnp.abs(updates["backbone"]["conv1"]["kernel"]).max()) \
+            == 0.0
+        # unfrozen params do get updates (incl. decoupled decay)
+        assert float(jnp.abs(updates["head"]["fc"]["kernel"]).max()) > 0.0
+
+
+class TestPRCurve:
+    def test_perfect_detector_ap_one(self):
+        out = precision_recall_curve(
+            np.array([0.9, 0.8, 0.7]), np.array([True, True, True]), n_gt=3)
+        assert out["ap"] > 0.99
+        assert np.all(out["precision"] == 1.0)
+        assert out["recall"][-1] == 1.0
+
+    def test_mixed_detections(self):
+        # conf-ordered: TP FP TP FP; 3 gts (one missed)
+        out = precision_recall_curve(
+            np.array([0.9, 0.8, 0.7, 0.6]),
+            np.array([True, False, True, False]), n_gt=3)
+        np.testing.assert_allclose(out["recall"],
+                                   [1 / 3, 1 / 3, 2 / 3, 2 / 3])
+        np.testing.assert_allclose(out["precision"],
+                                   [1.0, 0.5, 2 / 3, 0.5])
+        # AP: envelope is 1.0 until r=1/3, 2/3 until r=2/3, 0 beyond
+        assert 0.5 < out["ap"] < 0.62
+
+    def test_empty_detections(self):
+        out = precision_recall_curve(np.zeros((0,)), np.zeros((0,), bool),
+                                     n_gt=5)
+        assert out["ap"] == 0.0
+
+    def test_figure(self):
+        out = precision_recall_curve(
+            np.array([0.9, 0.8]), np.array([True, False]), n_gt=2)
+        fig = pr_curve_figure({"cls0": out})
+        assert fig is not None
+
+
+class TestEmbeddingProjection:
+    def test_tsne_and_pca(self):
+        rng = np.random.default_rng(0)
+        emb = np.concatenate([rng.normal(0, 0.1, (20, 8)),
+                              rng.normal(3, 0.1, (20, 8))])
+        labels = [0] * 20 + [1] * 20
+        assert embedding_projection_figure(emb, labels, "pca") is not None
+        assert embedding_projection_figure(emb, labels, "tsne") is not None

@@ -70,6 +70,13 @@ def test_torch_to_flax_forward_parity():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
 
 
+def test_embedding_weight_not_transposed():
+    emb = torch.nn.Embedding(100, 16)
+    sd = {"token_embed.weight": emb.weight}
+    out = torch_to_flax(sd)
+    assert out["params"]["token_embed"]["embedding"].shape == (100, 16)
+
+
 def test_load_torch_checkpoint_wrappers(tmp_path):
     net = _make_torch_net()
     path = tmp_path / "ckpt.pth"

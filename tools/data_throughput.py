@@ -11,7 +11,7 @@ The ViT-B/16 step rate on one v5e chip is ~960 img/s; the memmap path
 must beat that per host core, the JPEG path scales with decode cores
 (this build machine has ONE core — real TPU hosts have ~100+).
 
-Usage: python tools/data_throughput.py --folder /root/data/digits/cls
+Usage: python tools/data_throughput.py --folder .data/digits/cls
 """
 
 import argparse

@@ -12,7 +12,7 @@ scans, the classic UCI test set):
   written as images/ + COCO-format instances.json — exercises the COCO
   json + JPEG decode detection path (YOLOX datasets/coco.py capability).
 
-Usage: python tools/make_digits.py --root /root/data/digits --which both
+Usage: python tools/make_digits.py --root .data/digits --which both
 """
 
 from __future__ import annotations
@@ -91,7 +91,7 @@ def make_det(root: str, n_images: int = 800, canvas: int = 256,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--root", default="/root/data/digits")
+    ap.add_argument("--root", default=".data/digits")
     ap.add_argument("--which", default="both",
                     choices=["cls", "det", "both"])
     ap.add_argument("--det-images", type=int, default=800)
