@@ -67,6 +67,17 @@ def write_class_indices(class_to_idx: Dict[str, int], path: str) -> None:
 def load_image(path: str) -> np.ndarray:
     if path.lower().endswith(".npy"):
         return np.load(path)
+    if path.lower().endswith((".jpg", ".jpeg")):
+        # native libjpeg fast path (native/imagedec.cpp); decodes off the
+        # GIL so loader threads overlap. Check availability BEFORE the
+        # read so the fallback doesn't pay double file I/O.
+        from .native_decode import available, decode_jpeg
+        if available():
+            with open(path, "rb") as f:
+                data = f.read()
+            img = decode_jpeg(data)
+            if img is not None:
+                return img.astype(np.float32)
     from PIL import Image
     return np.asarray(Image.open(path).convert("RGB"), np.float32)
 

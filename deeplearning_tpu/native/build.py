@@ -19,13 +19,22 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _LIBS: dict = {}
 
+# per-library extra compile/link flags (system libs must be present;
+# load() returns None gracefully when they are not)
+_FLAGS = {
+    "imagedec": ["-ljpeg", "-lpthread"],
+}
+
 
 def _build(name: str) -> Optional[str]:
     src = os.path.join(_DIR, f"{name}.cpp")
     out = os.path.join(_DIR, f"lib{name}.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    # stale if older than the source OR this file (flag changes live here)
+    fresh_after = max(os.path.getmtime(src), os.path.getmtime(__file__))
+    if os.path.exists(out) and os.path.getmtime(out) >= fresh_after:
         return out
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+           + _FLAGS.get(name, []))
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return out
@@ -40,6 +49,9 @@ def load(name: str) -> Optional[ctypes.CDLL]:
         if name in _LIBS:
             return _LIBS[name]
         path = _build(name)
-        lib = ctypes.CDLL(path) if path else None
+        try:
+            lib = ctypes.CDLL(path) if path else None
+        except OSError:   # e.g. cached .so but runtime dep now missing
+            lib = None
         _LIBS[name] = lib
         return lib

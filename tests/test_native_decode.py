@@ -1,0 +1,95 @@
+"""Native JPEG decode worker (native/imagedec.cpp) vs PIL golden.
+
+The native-input-path analog of the reference's cv2/torchvision decode
+(YOLOX setup_env.py, swin zipreader.py)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from deeplearning_tpu.data.native_decode import (available, decode_jpeg,
+                                                 decode_resize_batch)
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="g++/libjpeg unavailable")
+
+
+def _jpeg_bytes(arr: np.ndarray, quality: int = 95) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _rand_img(h, w, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (h, w, 3), dtype=np.uint8)
+
+
+class TestDecode:
+    def test_matches_pil_decode(self):
+        from PIL import Image
+        data = _jpeg_bytes(_rand_img(37, 53))
+        got = decode_jpeg(data)
+        want = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        assert got.shape == want.shape == (37, 53, 3)
+        # both decode through libjpeg; allow 1-2 levels of rounding skew
+        assert np.abs(got.astype(int) - want.astype(int)).mean() < 2.0
+
+    def test_corrupt_returns_none(self):
+        assert decode_jpeg(b"not a jpeg") is None
+        data = bytearray(_jpeg_bytes(_rand_img(16, 16)))
+        assert decode_jpeg(bytes(data[: len(data) // 4])) is None
+
+
+class TestBatchResize:
+    def test_batch_shapes_and_content(self):
+        blobs = [_jpeg_bytes(_rand_img(40, 30, s)) for s in range(5)]
+        out = decode_resize_batch(blobs, 24, 24, n_threads=3)
+        assert out.shape == (5, 24, 24, 3) and out.dtype == np.uint8
+        # images differ from each other (decode actually ran per-slot)
+        assert len({int(x.sum()) for x in out}) == 5
+
+    def test_resize_constant_image_exact(self):
+        img = np.full((33, 47, 3), 137, np.uint8)
+        out = decode_resize_batch([_jpeg_bytes(img, quality=100)], 16, 20)
+        # constant field survives bilinear resize (JPEG q100 keeps flat
+        # blocks nearly exact)
+        assert np.abs(out[0].astype(int) - 137).max() <= 2
+
+    def test_upsample_matches_pil_bilinear(self):
+        # UPsampling: PIL's bilinear has no antialias support scaling, so
+        # both implement the same half-pixel point-bilinear and must
+        # agree closely. (Downsampling intentionally differs: PIL
+        # area-averages, this kernel point-samples like cv2.)
+        from PIL import Image
+        img = _rand_img(16, 12, 7)
+        data = _jpeg_bytes(img, quality=100)
+        out = decode_resize_batch([data], 32, 24)[0]
+        pil = Image.open(io.BytesIO(data)).convert("RGB").resize(
+            (24, 32), Image.BILINEAR)
+        diff = np.abs(out.astype(int) - np.asarray(pil).astype(int))
+        assert diff.mean() < 2.0
+
+    def test_failed_slot_zero_filled(self):
+        blobs = [_jpeg_bytes(_rand_img(16, 16)), b"garbage"]
+        out = decode_resize_batch(blobs, 8, 8)
+        assert out[1].sum() == 0 and out[0].sum() > 0
+
+    def test_empty_batch(self):
+        assert decode_resize_batch([], 8, 8).shape == (0, 8, 8, 3)
+
+
+class TestLoadImageIntegration:
+    def test_folder_load_uses_native(self, tmp_path):
+        from PIL import Image
+        from deeplearning_tpu.data.datasets import load_image
+        img = _rand_img(20, 22)
+        p = tmp_path / "x.jpg"
+        p.write_bytes(_jpeg_bytes(img))
+        out = load_image(str(p))
+        assert out.shape == (20, 22, 3) and out.dtype == np.float32
+        # compare against PIL's decode of the same (lossy) file
+        want = np.asarray(Image.open(p).convert("RGB"), np.float32)
+        assert np.abs(out - want).mean() < 2.0

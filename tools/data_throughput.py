@@ -63,6 +63,31 @@ def bench_memmap(image_size: int, batch: int, n_batches: int,
         return n / (time.perf_counter() - t0)
 
 
+def bench_native_batch(root: str, image_size: int, batch: int,
+                       num_workers: int, n_batches: int) -> float:
+    """Raw C++ decode_resize_batch rate (native/imagedec.cpp thread pool,
+    no augment) — the upper bound of the native input path."""
+    from deeplearning_tpu.data.native_decode import (available,
+                                                     decode_resize_batch)
+    if not available():
+        return 0.0
+    paths = []
+    for dirpath, _, files in os.walk(root):
+        paths += [os.path.join(dirpath, f) for f in files
+                  if f.lower().endswith((".jpg", ".jpeg"))]
+    if not paths:
+        return 0.0
+    blobs = [open(p, "rb").read() for p in paths[:batch]]
+    decode_resize_batch(blobs, image_size, image_size, num_workers)  # warm
+    t0 = time.perf_counter()
+    n = 0
+    for i in range(n_batches):
+        sel = [blobs[(i * 7 + j) % len(blobs)] for j in range(batch)]
+        decode_resize_batch(sel, image_size, image_size, num_workers)
+        n += batch
+    return n / (time.perf_counter() - t0)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--folder", default=None)
@@ -76,6 +101,11 @@ def main():
     print(f"memmap_cache: {mm:,.0f} img/s "
           f"({args.image_size}px, batch {args.batch}, 1 host core)")
     if args.folder:
+        nb = bench_native_batch(args.folder, args.image_size, args.batch,
+                                args.workers, args.batches)
+        if nb:
+            print(f"native_decode_resize: {nb:,.0f} img/s "
+                  f"(C++ pool, {args.workers} threads)")
         jf = bench_jpeg_folder(args.folder, args.image_size, args.batch,
                                args.workers, args.batches)
         print(f"jpeg_decode+augment: {jf:,.0f} img/s "
