@@ -130,3 +130,58 @@ class ViTB16Exp(BaseExp):
     weight_decay = 0.05
     label_smoothing = 0.1
     ema = True
+
+
+class DetectionExp(BaseExp):
+    """Detector experiment — the yolox_base.py:16 Exp attribute surface
+    (input_size, multiscale random_resize:167, test_conf) mapped onto the
+    detection CLI's config tree. ``cli_overrides`` turns the exp into
+    dotted overrides for tools/train_detection.py --exp."""
+    model_name = "yolox_s"
+    num_classes = 80
+    img_size = 640
+    max_gt = 50
+    global_batch = 8
+    max_steps = 300
+    base_lr = 1e-3
+    clip_grad_norm = 1.0
+    score_thresh = 0.3               # test_conf analog
+    multiscale = True                # random_resize bucketed analog
+
+    def cli_overrides(self):
+        return [
+            f"model.name={self.model_name}",
+            f"model.num_classes={self.num_classes}",
+            f"model.image_size={self.img_size}",
+            f"data.max_gt={self.max_gt}",
+            f"data.batch={self.global_batch}",
+            f"train.steps={self.max_steps}",
+            f"train.lr={self.base_lr}",
+            f"train.clip_grad_norm={self.clip_grad_norm}",
+            f"train.eval_score_thresh={self.score_thresh}",
+            f"train.multiscale={str(self.multiscale).lower()}",
+        ]
+
+    def get_evaluator(self):
+        from ..evaluation.coco_eval import CocoEvaluator
+        return CocoEvaluator(num_classes=self.num_classes)
+
+
+def _det_exp(name, **attrs):
+    cls = type(f"Exp_{name}", (DetectionExp,),
+               {"model_name": attrs.pop("model_name", name), **attrs})
+    EXPERIMENTS.register(name)(cls)
+    return cls
+
+
+# exps/default/* zoo (s/m/l/x scale by the registry model; tiny/nano use
+# the reference's 416 input; yolov3 is the CSP-darknet53 variant)
+_det_exp("yolox_s")
+_det_exp("yolox_m")
+_det_exp("yolox_l")
+_det_exp("yolox_x")
+_det_exp("yolox_tiny", img_size=416)
+_det_exp("yolox_nano", img_size=416)
+_det_exp("yolox_yolov3")
+# exps/example/yolox_voc/yolox_voc_s.py analog
+_det_exp("yolox_voc_s", model_name="yolox_s", num_classes=20)

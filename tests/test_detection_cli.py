@@ -23,3 +23,23 @@ def test_family_trains_and_evaluates(name, extra, capsys):
     out = capsys.readouterr().out
     assert "'AP'" in out          # evaluator summary printed
     assert "nan" not in out
+
+
+def test_exp_zoo_registered():
+    from deeplearning_tpu.core.experiment import EXPERIMENTS, get_exp
+    for name in ("yolox_s", "yolox_m", "yolox_l", "yolox_x", "yolox_tiny",
+                 "yolox_nano", "yolox_yolov3", "yolox_voc_s"):
+        exp = get_exp(exp_name=name)
+        ov = exp.cli_overrides()
+        assert f"model.name={exp.model_name}" in ov
+    assert get_exp(exp_name="yolox_tiny").img_size == 416
+    assert get_exp(exp_name="yolox_voc_s").num_classes == 20
+
+
+def test_exp_flag_drives_cli(capsys):
+    from train_detection import main
+    rc = main(["--exp", "yolox_nano", "model.image_size=64",
+               "data.batch=2", "data.n_train=4", "data.max_gt=4",
+               "model.num_classes=3", "train.steps=2"])
+    assert rc == 0
+    assert "'AP'" in capsys.readouterr().out

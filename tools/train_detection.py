@@ -232,7 +232,28 @@ def main(argv=None) -> int:
     from deeplearning_tpu.train.multiscale import (MultiScaleSchedule,
                                                    resize_detection_batch)
 
-    cfg = config_cli(DetConfig(), argv, description=__doc__)
+    # --exp NAME: seed the config DEFAULTS from a registered DetectionExp
+    # (exps/default/* analog). Precedence: defaults < exp < yaml < CLI.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    exp_name = None
+    for i, a in enumerate(argv):
+        if a == "--exp":
+            if i + 1 >= len(argv):
+                raise SystemExit("--exp requires a name, e.g. --exp yolox_s")
+            exp_name = argv[i + 1]
+            del argv[i:i + 2]
+            break
+        if a.startswith("--exp="):
+            exp_name = a.split("=", 1)[1]
+            del argv[i]
+            break
+    defaults = DetConfig()
+    if exp_name:
+        from deeplearning_tpu.core.config import load_config
+        from deeplearning_tpu.core.experiment import get_exp
+        defaults = load_config(
+            defaults, None, get_exp(exp_name=exp_name).cli_overrides())
+    cfg = config_cli(defaults, argv, description=__doc__)
     size = cfg.model.image_size
     num_classes = cfg.model.num_classes
     train_src = val_src = None
