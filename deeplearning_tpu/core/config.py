@@ -179,6 +179,23 @@ def save_config(cfg: Any, path: str) -> None:
         yaml.safe_dump(asdict(cfg), f, sort_keys=False)
 
 
+def pop_flag(argv: list, name: str) -> Optional[str]:
+    """Extract ``name VALUE`` or ``name=VALUE`` from argv in place and
+    return the value (None if absent). For CLI flags that must be read
+    before config_cli's argparse (e.g. --exp / --task selectors)."""
+    for i, a in enumerate(argv):
+        if a == name:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{name} requires a value")
+            value = argv[i + 1]
+            del argv[i:i + 2]
+            return value
+        if a.startswith(name + "="):
+            del argv[i]
+            return a.split("=", 1)[1]
+    return None
+
+
 def config_cli(defaults: T, argv: Optional[Sequence[str]] = None,
                description: str = "") -> T:
     """Standard CLI: ``prog [--cfg FILE] [key value | key=value ...]``."""

@@ -1,0 +1,36 @@
+"""Family task CLI: every task trains a few steps and prints its metric
+(tools/train_task.py — the per-project train.py successors for
+segmentation / MAE / SupCon / metric learning / keypoints / stereo)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.mark.parametrize("task,extra", [
+    ("segmentation", ["model.image_size=32", "data.batch=2",
+                      "train.steps=3"]),
+    ("mae", ["model.image_size=32", "data.batch=2", "train.steps=3"]),
+    ("supcon", ["model.image_size=32", "data.batch=8", "train.steps=3"]),
+    ("metric", ["model.image_size=32", "data.batch=8", "train.steps=3",
+                "model.num_classes=4"]),
+    ("keypoints", ["model.image_size=64", "data.batch=2",
+                   "train.steps=3"]),
+    ("stereo", ["model.image_size=64", "train.steps=3"]),
+])
+def test_task_trains(task, extra, capsys):
+    from train_task import main
+    rc = main(["--task", task] + extra)
+    out = capsys.readouterr().out
+    assert "task_metric" in out
+    assert rc == 0
+
+
+def test_unknown_task():
+    from train_task import main
+    with pytest.raises(SystemExit):
+        main(["--task", "nope"])

@@ -20,20 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def sync(x):
-    # D2H scalar fetch — block_until_ready is unreliable on this backend
-    jnp.asarray(x).ravel()[0].item()
-
-
-def bench(fn, args, n=30, warmup=3):
-    for _ in range(warmup):
-        out = fn(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    sync(out)
-    return (time.perf_counter() - t0) / n
+from bench_util import bench
 
 
 def main():

@@ -1,0 +1,22 @@
+"""Shared timing helpers for the TPU microbenchmarks."""
+
+import time
+
+import jax.numpy as jnp
+
+
+def sync(x):
+    # D2H scalar fetch — block_until_ready is unreliable on this
+    # remote-tunnel backend; a host fetch always syncs
+    jnp.asarray(x).ravel()[0].item()
+
+
+def bench(fn, args, n=30, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / n

@@ -234,19 +234,9 @@ def main(argv=None) -> int:
 
     # --exp NAME: seed the config DEFAULTS from a registered DetectionExp
     # (exps/default/* analog). Precedence: defaults < exp < yaml < CLI.
+    from deeplearning_tpu.core.config import pop_flag
     argv = list(sys.argv[1:] if argv is None else argv)
-    exp_name = None
-    for i, a in enumerate(argv):
-        if a == "--exp":
-            if i + 1 >= len(argv):
-                raise SystemExit("--exp requires a name, e.g. --exp yolox_s")
-            exp_name = argv[i + 1]
-            del argv[i:i + 2]
-            break
-        if a.startswith("--exp="):
-            exp_name = a.split("=", 1)[1]
-            del argv[i]
-            break
+    exp_name = pop_flag(argv, "--exp")
     defaults = DetConfig()
     if exp_name:
         from deeplearning_tpu.core.config import load_config

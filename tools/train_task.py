@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Unified task CLI for the non-classification families — the successor
+of the reference's per-project train.py entries: Image_segmentation/*/
+train.py, self-supervised/MAE/train.py, self-supervised/SupCon (trainer/
+trainer.py), metric_learning/BDB/main.py, pose_estimation/Insulator/
+train.py, deep_stereo Stereo_Online_Adaptation.py.
+
+Usage:
+  python tools/train_task.py --task segmentation model.name=unet
+  python tools/train_task.py --task mae train.steps=20
+  python tools/train_task.py --task supcon
+  python tools/train_task.py --task metric
+  python tools/train_task.py --task keypoints
+  python tools/train_task.py --task stereo
+
+Each task trains on synthetic (or npz) data with the family's loss and
+prints a task metric at the end — the smoke-train surface the reference
+covers with its bundled mini-datasets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCfg:
+    name: str = ""                   # per-task default if empty
+    num_classes: int = 4
+    image_size: int = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class DataCfg:
+    npz: Optional[str] = None
+    n_train: int = 32
+    batch: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainCfg:
+    steps: int = 30
+    lr: float = 1e-3
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskConfig:
+    model: ModelCfg = dataclasses.field(default_factory=ModelCfg)
+    data: DataCfg = dataclasses.field(default_factory=DataCfg)
+    train: TrainCfg = dataclasses.field(default_factory=TrainCfg)
+
+
+DEFAULT_MODEL = {
+    "segmentation": "unet",
+    "mae": "mae_vit_small_patch16",
+    "supcon": "supcon_resnet18",
+    "metric": "arcface_resnet18",
+    "keypoints": "hrnet_w18_keypoints",
+    "stereo": "madnet",
+}
+
+
+def _loop(loss_fn, params, steps, lr, extra=None):
+    """Shared Adam loop: loss_fn(params, step) -> scalar loss."""
+    import optax
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, i):
+        loss, g = jax.value_and_grad(lambda p: loss_fn(p, i))(params)
+        up, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, up), opt, loss
+
+    first = last = None
+    for i in range(steps):
+        params, opt, loss = step(params, opt, jnp.asarray(i))
+        last = float(loss)
+        if first is None:
+            first = last
+        if i % max(steps // 5, 1) == 0:
+            print(f"step {i}: loss={last:.4f}", flush=True)
+    if last is None:
+        print("no steps run")
+        return params, float("nan"), float("nan")
+    print(f"loss {first:.4f} -> {last:.4f}")
+    return params, first, last
+
+
+def run_segmentation(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
+                                                     miou_from_confusion)
+    from deeplearning_tpu.ops import losses as L
+
+    s = cfg.model.image_size
+    rng = np.random.default_rng(cfg.train.seed)
+    x = rng.normal(0, 0.1, (cfg.data.batch, s, s, 3)).astype(np.float32)
+    y = np.zeros((cfg.data.batch, s, s), np.int32)
+    for i in range(cfg.data.batch):
+        cx, cy, r = rng.integers(8, s - 8), rng.integers(8, s - 8), 6
+        yy, xx = np.mgrid[:s, :s]
+        m = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+        y[i][m] = 1
+        x[i][m] += 1.0
+    x, y = jnp.asarray(x), jnp.asarray(y)
+
+    model = MODELS.build(cfg.model.name or "unet", num_classes=2,
+                         dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), x[:1], train=False)
+    params, stats = variables["params"], variables.get("batch_stats", {})
+
+    def loss_fn(p, i):
+        out = model.apply({"params": p, "batch_stats": stats}, x,
+                          train=False)
+        logits = out[0] if isinstance(out, tuple) else out
+        return L.cross_entropy(logits, y) + L.dice_loss(logits, y)
+
+    params, first, last = _loop(loss_fn, params, cfg.train.steps,
+                                cfg.train.lr)
+    out = model.apply({"params": params, "batch_stats": stats}, x,
+                      train=False)
+    logits = out[0] if isinstance(out, tuple) else out
+    mat = confusion_matrix(jnp.argmax(logits, -1), y, 2)
+    miou = miou_from_confusion(np.asarray(mat))["miou"]
+    print(f"task_metric miou={float(miou):.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+def run_mae(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+
+    s = max(cfg.model.image_size, 32)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(cfg.data.batch, s, s, 3)), jnp.float32)
+    model = MODELS.build(cfg.model.name or "mae_vit_small_patch16",
+                         dtype=jnp.float32, depth=2, decoder_depth=2)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        x, train=False)
+
+    def loss_fn(p, i):
+        loss, _, _ = model.apply(
+            {"params": p}, x, train=True,
+            rngs={"masking": jax.random.fold_in(jax.random.key(5), i),
+                  "dropout": jax.random.fold_in(jax.random.key(6), i)})
+        return loss
+
+    _, first, last = _loop(loss_fn, variables["params"], cfg.train.steps,
+                           cfg.train.lr)
+    print(f"task_metric mae_recon_loss={last:.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+def run_supcon(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.ops import losses as L
+
+    s = cfg.model.image_size
+    rng = np.random.default_rng(0)
+    labels = np.repeat(np.arange(max(cfg.data.batch // 2, 1)), 2)
+    base = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
+    base[np.arange(len(labels)), labels * 3 % s, labels * 3 % s, :] += 2.0
+    x, y = jnp.asarray(base), jnp.asarray(labels)
+
+    model = MODELS.build(cfg.model.name or "supcon_resnet18",
+                         num_classes=cfg.model.num_classes,
+                         dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), x[:1], train=False)
+    params, stats = variables["params"], variables.get("batch_stats", {})
+
+    def loss_fn(p, i):
+        z = model.apply({"params": p, "batch_stats": stats}, x, train=False)
+        feats = jnp.stack([z, z], axis=1)   # two-view stand-in
+        return L.supcon_loss(feats, y)
+
+    _, first, last = _loop(loss_fn, params, cfg.train.steps, cfg.train.lr)
+    print(f"task_metric supcon_loss={last:.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+def run_metric(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.evaluation.retrieval import (cmc_map,
+                                                       pairwise_distances)
+    from deeplearning_tpu.ops import losses as L
+
+    s = cfg.model.image_size
+    rng = np.random.default_rng(0)
+    n_id = cfg.model.num_classes
+    labels = np.repeat(np.arange(n_id), max(cfg.data.batch // n_id, 2))
+    x = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
+    for i, lab in enumerate(labels):
+        x[i, :, lab * 4 % s:(lab * 4 % s) + 3, :] += 1.5
+    x, y = jnp.asarray(x), jnp.asarray(labels)
+
+    model = MODELS.build(cfg.model.name or "arcface_resnet18",
+                         num_classes=n_id, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), x[:1], train=False)
+    params, stats = variables["params"], variables.get("batch_stats", {})
+
+    def loss_fn(p, i):
+        out = model.apply({"params": p, "batch_stats": stats}, x,
+                          train=False)
+        emb, centers = out["embedding"], out["centers"]
+        logits = L.arcface_logits(emb, centers, y)
+        return L.cross_entropy(logits, y) + L.triplet_loss(emb, y,
+                                                           margin=0.3)
+
+    params, first, last = _loop(loss_fn, params, cfg.train.steps,
+                                cfg.train.lr)
+    out = model.apply({"params": params, "batch_stats": stats}, x,
+                      train=False)
+    emb = np.asarray(out["embedding"])
+    # interleave query/gallery so every query id appears in the gallery
+    # (a contiguous split would separate the id sets -> vacuous metric)
+    q, g = emb[0::2], emb[1::2]
+    yq, yg = np.asarray(y)[0::2], np.asarray(y)[1::2]
+    dist = pairwise_distances(q, g)
+    res = cmc_map(dist, yq, yg)
+    print(f"task_metric rank1={res['rank1']:.4f} mAP={res['mAP']:.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+def run_keypoints(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.evaluation.keypoints import (decode_heatmaps,
+                                                       make_heatmap_targets,
+                                                       pck)
+    from deeplearning_tpu.ops import losses as L
+
+    s = max(cfg.model.image_size, 64)
+    k = 4
+    rng = np.random.default_rng(0)
+    kps = rng.uniform(8, s - 8, (cfg.data.batch, k, 2)).astype(np.float32)
+    vis = np.ones((cfg.data.batch, k), np.float32)
+    x = np.zeros((cfg.data.batch, s, s, 3), np.float32)
+    for i in range(cfg.data.batch):
+        for j in range(k):
+            xx, yy = int(kps[i, j, 0]), int(kps[i, j, 1])
+            x[i, max(yy - 1, 0):yy + 2, max(xx - 1, 0):xx + 2, j % 3] = 2.0
+    target = jnp.asarray(np.stack([
+        make_heatmap_targets(kps[i], vis[i], (s // 4, s // 4), stride=4)
+        for i in range(cfg.data.batch)]))
+    x = jnp.asarray(x)
+
+    model = MODELS.build(cfg.model.name or "hrnet_w18_keypoints",
+                         num_classes=k, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), x[:1], train=False)
+    params, stats = variables["params"], variables.get("batch_stats", {})
+
+    def loss_fn(p, i):
+        heat = model.apply({"params": p, "batch_stats": stats}, x,
+                           train=False)
+        return L.heatmap_mse_loss(heat, target, jnp.asarray(vis))
+
+    params, first, last = _loop(loss_fn, params, cfg.train.steps,
+                                cfg.train.lr)
+    heat = model.apply({"params": params, "batch_stats": stats}, x,
+                       train=False)
+    pred, _ = decode_heatmaps(heat, stride=4)
+    pred = np.asarray(pred)
+    score = float(np.mean([pck(pred[i], kps[i], vis[i],
+                               threshold_px=s * 0.2)
+                           for i in range(len(pred))]))
+    print(f"task_metric pck@0.2={float(score):.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+def run_stereo(cfg: TaskConfig) -> int:
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.models.stereo.madnet import photometric_loss
+
+    s = max(cfg.model.image_size, 64)
+    rng = np.random.default_rng(0)
+    left = rng.normal(0, 1, (2, s, s, 3)).astype(np.float32)
+    right = np.roll(left, -3, axis=2)
+    left, right = jnp.asarray(left), jnp.asarray(right)
+
+    model = MODELS.build(cfg.model.name or "madnet", dtype=jnp.float32)
+    params = model.init(jax.random.key(0), left, right)["params"]
+
+    def loss_fn(p, i):
+        out = model.apply({"params": p}, left, right)
+        return photometric_loss(left, right, out["disparity"])
+
+    _, first, last = _loop(loss_fn, params, cfg.train.steps, cfg.train.lr)
+    print(f"task_metric photometric={last:.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
+RUNNERS = {
+    "segmentation": run_segmentation,
+    "mae": run_mae,
+    "supcon": run_supcon,
+    "metric": run_metric,
+    "keypoints": run_keypoints,
+    "stereo": run_stereo,
+}
+
+
+def main(argv=None) -> int:
+    from deeplearning_tpu.core.config import config_cli, pop_flag
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    task = pop_flag(argv, "--task")
+    if task not in RUNNERS:
+        raise SystemExit(f"--task must be one of {list(RUNNERS)}")
+    cfg = config_cli(TaskConfig(), argv, description=__doc__)
+    return RUNNERS[task](cfg)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
