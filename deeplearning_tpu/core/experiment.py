@@ -132,6 +132,41 @@ class ViTB16Exp(BaseExp):
     ema = True
 
 
+@EXPERIMENTS.register("swin_tiny")
+class SwinTinyExp(BaseExp):
+    model_name = "swin_tiny_patch4_window7_224"
+    num_classes = 1000
+    global_batch = 128
+    base_lr = 1e-3
+    optimizer = "adamw"
+    weight_decay = 0.05
+    label_smoothing = 0.1
+    ema = True
+
+
+@EXPERIMENTS.register("resnet50")
+class ResNet50Exp(BaseExp):
+    model_name = "resnet50"
+    num_classes = 1000
+    global_batch = 256
+    base_lr = 0.1
+    optimizer = "sgd"
+    weight_decay = 1e-4
+
+
+@EXPERIMENTS.register("mae_pretrain")
+class MAEPretrainExp(BaseExp):
+    """MAE pretrain defaults (self-supervised/MAE/train.py surface:
+    mask_ratio 0.75, LARS/AdamW large-batch schedule)."""
+    model_name = "mae_vit_base_patch16"
+    num_classes = 0
+    global_batch = 256
+    base_lr = 1.5e-4
+    optimizer = "adamw"
+    weight_decay = 0.05
+    ema = False
+
+
 class DetectionExp(BaseExp):
     """Detector experiment — the yolox_base.py:16 Exp attribute surface
     (input_size, multiscale random_resize:167, test_conf) mapped onto the

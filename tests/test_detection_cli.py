@@ -34,6 +34,11 @@ def test_exp_zoo_registered():
         assert f"model.name={exp.model_name}" in ov
     assert get_exp(exp_name="yolox_tiny").img_size == 416
     assert get_exp(exp_name="yolox_voc_s").num_classes == 20
+    # classification / ssl presets
+    for name in ("swin_tiny", "resnet50", "mae_pretrain", "vit_b16"):
+        assert get_exp(exp_name=name).model_name
+    ev = get_exp(exp_name="yolox_voc_s").get_evaluator()
+    assert ev.num_classes == 20
 
 
 def test_exp_flag_drives_cli(capsys):

@@ -11,7 +11,6 @@ long-context sizes — fwd and fwd+bwd. Prints a markdown table; the
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -48,10 +47,18 @@ def main():
         t = lambda x: x.transpose(0, 2, 1, 3)
         return t(dot_product_attention(t(q), t(k), t(v)))
 
+    def jax_flash(q, k, v):
+        # the JAX-team-tuned TPU kernel (public jax.experimental) — the
+        # external reference our kernels are judged against
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jf)
+        return jf(q, k, v, sm_scale=q.shape[-1] ** -0.5)
+
     variants = {
         "naive": naive_bhnd,
         "flash": flash_attention,
         "flash_hb": flash_attention_hb,
+        "jax_flash": jax_flash,
     }
 
     print(f"| shape (B,H,N,D) | mode | " + " | ".join(variants) +

@@ -2,13 +2,16 @@
 
 import time
 
+import jax
 import jax.numpy as jnp
 
 
 def sync(x):
     # D2H scalar fetch — block_until_ready is unreliable on this
-    # remote-tunnel backend; a host fetch always syncs
-    jnp.asarray(x).ravel()[0].item()
+    # remote-tunnel backend; a host fetch always syncs. Accepts any
+    # pytree: syncs on its first leaf.
+    jnp.asarray(jax.tree.leaves(x)[0]).ravel()[0].astype(
+        jnp.float32).item()
 
 
 def bench(fn, args, n=30, warmup=3):

@@ -32,7 +32,7 @@ import numpy as np
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--attn", default="naive",
-                    choices=["naive", "flash", "flash_hb"])
+                    choices=["naive", "flash", "flash_hb", "sdpa"])
     ap.add_argument("--head-block", type=int, default=4)
     ap.add_argument("--block-q", type=int, default=128)
     ap.add_argument("--block-k", type=int, default=128)
@@ -49,7 +49,16 @@ def main():
     from deeplearning_tpu.train.schedules import build_schedule
 
     attn_fn = None
-    if args.attn == "flash":
+    if args.attn == "sdpa":
+        # jax.nn.dot_product_attention takes (B, N, H, D) directly — the
+        # XLA-native SDPA entry that can lower to a fused attention
+        def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True,
+                    rng=None):
+            if dropout_rate > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "sdpa variant has no attention dropout")
+            return jax.nn.dot_product_attention(q, k, v)
+    elif args.attn == "flash":
         from deeplearning_tpu.ops.attention import flash_attn_adapter
         attn_fn = flash_attn_adapter
     elif args.attn == "flash_hb":

@@ -25,20 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def sync(x):
-    jnp.asarray(x).ravel()[0].astype(jnp.float32).item()
-
-
-def bench(fn, args, n=20, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    out = jax.tree.leaves(out)[0]
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    sync(jax.tree.leaves(out)[0])
-    return (time.perf_counter() - t0) / n
+from bench_util import bench, sync
 
 
 def stage1_probe():

@@ -75,7 +75,7 @@ DEFAULT_MODEL = {
 }
 
 
-def _loop(loss_fn, params, steps, lr, extra=None):
+def _loop(loss_fn, params, steps, lr):
     """Shared Adam loop: loss_fn(params, step) -> scalar loss."""
     import optax
     tx = optax.adam(lr)
@@ -146,7 +146,7 @@ def run_mae(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
 
     s = max(cfg.model.image_size, 32)
-    x = jnp.asarray(np.random.default_rng(0).normal(
+    x = jnp.asarray(np.random.default_rng(cfg.train.seed).normal(
         size=(cfg.data.batch, s, s, 3)), jnp.float32)
     model = MODELS.build(cfg.model.name or "mae_vit_small_patch16",
                          dtype=jnp.float32, depth=2, decoder_depth=2)
@@ -172,7 +172,7 @@ def run_supcon(cfg: TaskConfig) -> int:
     from deeplearning_tpu.ops import losses as L
 
     s = cfg.model.image_size
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(cfg.train.seed)
     labels = np.repeat(np.arange(max(cfg.data.batch // 2, 1)), 2)
     base = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
     base[np.arange(len(labels)), labels * 3 % s, labels * 3 % s, :] += 2.0
@@ -201,7 +201,7 @@ def run_metric(cfg: TaskConfig) -> int:
     from deeplearning_tpu.ops import losses as L
 
     s = cfg.model.image_size
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(cfg.train.seed)
     n_id = cfg.model.num_classes
     labels = np.repeat(np.arange(n_id), max(cfg.data.batch // n_id, 2))
     x = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
@@ -246,7 +246,7 @@ def run_keypoints(cfg: TaskConfig) -> int:
 
     s = max(cfg.model.image_size, 64)
     k = 4
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(cfg.train.seed)
     kps = rng.uniform(8, s - 8, (cfg.data.batch, k, 2)).astype(np.float32)
     vis = np.ones((cfg.data.batch, k), np.float32)
     x = np.zeros((cfg.data.batch, s, s, 3), np.float32)
@@ -287,8 +287,9 @@ def run_stereo(cfg: TaskConfig) -> int:
     from deeplearning_tpu.models.stereo.madnet import photometric_loss
 
     s = max(cfg.model.image_size, 64)
-    rng = np.random.default_rng(0)
-    left = rng.normal(0, 1, (2, s, s, 3)).astype(np.float32)
+    rng = np.random.default_rng(cfg.train.seed)
+    b = max(cfg.data.batch, 1)
+    left = rng.normal(0, 1, (b, s, s, 3)).astype(np.float32)
     right = np.roll(left, -3, axis=2)
     left, right = jnp.asarray(left), jnp.asarray(right)
 
