@@ -159,12 +159,18 @@ class MAEPretrainExp(BaseExp):
     """MAE pretrain defaults (self-supervised/MAE/train.py surface:
     mask_ratio 0.75, LARS/AdamW large-batch schedule)."""
     model_name = "mae_vit_base_patch16"
-    num_classes = 0
+    num_classes = 0                  # pretrain has no classifier head
     global_batch = 256
     base_lr = 1.5e-4
     optimizer = "adamw"
     weight_decay = 0.05
     ema = False
+
+    def get_model(self, **kw):
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+        # MAE has no num_classes field (reconstruction objective)
+        return MODELS.build(self.model_name, dtype=dtype, **kw)
 
 
 class DetectionExp(BaseExp):

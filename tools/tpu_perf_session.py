@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-from bench_util import bench, sync
+from bench_util import bench
 
 
 def stage1_probe():
