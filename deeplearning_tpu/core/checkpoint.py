@@ -88,10 +88,16 @@ def save_pytree(path: str, tree: Any) -> None:
 
 
 def load_pytree(path: str, target: Optional[Any] = None) -> Any:
+    path = os.path.abspath(path)
+    # CheckpointManager steps wrap the tree in a "default" item dir
+    default = os.path.join(path, "default")
+    if not os.path.exists(os.path.join(path, "_METADATA")) \
+            and os.path.exists(os.path.join(default, "_METADATA")):
+        path = default
     with ocp.StandardCheckpointer() as ckptr:
         if target is not None:
-            return ckptr.restore(os.path.abspath(path), target)
-        return ckptr.restore(os.path.abspath(path))
+            return ckptr.restore(path, target)
+        return ckptr.restore(path)
 
 
 def surgical_load(

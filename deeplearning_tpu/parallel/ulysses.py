@@ -65,7 +65,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if attn_fn is None:
         out = _default_attention(qh, kh, vh, sm_scale)
     else:
-        out = attn_fn(qh, kh, vh)
+        # attn_fn must accept sm_scale — forwarded so an explicit scale
+        # is never silently dropped (flash_attention takes it as kw)
+        out = attn_fn(qh, kh, vh, sm_scale=sm_scale)
     return gather_heads(out.astype(q.dtype))
 
 

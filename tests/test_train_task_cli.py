@@ -21,6 +21,8 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
     ("keypoints", ["model.image_size=64", "data.batch=2",
                    "train.steps=3"]),
     ("stereo", ["model.image_size=64", "train.steps=3"]),
+    ("stereo_online", ["model.image_size=64", "data.batch=1",
+                       "train.steps=3", "train.lr=1e-4"]),
 ])
 def test_task_trains(task, extra, capsys):
     from train_task import main

@@ -33,11 +33,21 @@ def main(argv=None) -> int:
     ap.add_argument("--model", required=True)
     ap.add_argument("--num-classes", type=int, default=10)
     ap.add_argument("--ckpt", default=None)
-    ap.add_argument("--npz", required=True,
+    ap.add_argument("--npz", default=None,
                     help="npz with model-ready 'images' and 'labels'")
+    ap.add_argument("--folder", default=None,
+                    help="ImageFolder root (real JPEG eval, val split)")
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--val-rate", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="split seed — MUST match train.seed for the "
+                         "--folder val split to be truly held out")
+    ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--out-json", default=None)
     args = ap.parse_args(argv)
+    if not args.npz and not args.folder:
+        ap.error("one of --npz / --folder is required")
 
     from deeplearning_tpu.core.checkpoint import load_pytree
     from deeplearning_tpu.core.registry import MODELS
@@ -45,16 +55,51 @@ def main(argv=None) -> int:
                                                      miou_from_confusion,
                                                      topk_correct)
 
-    blob = np.load(args.npz)
-    images, labels = blob["images"], blob["labels"]
+    if args.npz:
+        blob = np.load(args.npz)
+        images, labels = blob["images"], blob["labels"]
+
+        def batches():
+            bs = max(min(args.batch, len(images)), 1)
+            n = (len(images) // bs) * bs
+            for start in range(0, n, bs):
+                yield (images[start:start + bs], labels[start:start + bs])
+        sample = images[:1]
+    else:
+        # reuse the training-side loader stack (worker-pool decode,
+        # clamped val batch) with the SAME split seed as training
+        from deeplearning_tpu.data.build import (LoaderConfig,
+                                                 build_classification_loaders)
+        lcfg = LoaderConfig(global_batch=args.batch,
+                            image_size=args.image_size,
+                            val_rate=args.val_rate, seed=args.seed,
+                            num_workers=args.workers)
+        _, val_loader, class_to_idx = build_classification_loaders(
+            args.folder, lcfg)
+        if len(class_to_idx) != args.num_classes:
+            ap.error(f"--num-classes {args.num_classes} but folder has "
+                     f"{len(class_to_idx)} classes")
+
+        def batches():
+            for batch in val_loader:
+                yield (batch["image"], batch["label"])
+        sample = next(iter(val_loader))["image"][:1]
     model = MODELS.build(args.model, num_classes=args.num_classes)
     variables = model.init(jax.random.key(0),
-                           jnp.asarray(images[:1]), train=False)
+                           jnp.asarray(sample), train=False)
     if args.ckpt:
         restored = load_pytree(args.ckpt)
-        params = restored.get("params", restored) \
-            if isinstance(restored, dict) else restored
-        variables = {**variables, "params": params}
+        if isinstance(restored, dict):
+            # TrainState checkpoints carry params (+ ema_params +
+            # batch_stats); BN stats MUST come from the checkpoint, not
+            # from init, or eval runs with untrained statistics
+            params = restored.get("ema_params") or restored.get(
+                "params", restored)
+            variables = {**variables, "params": params}
+            if restored.get("batch_stats"):
+                variables["batch_stats"] = restored["batch_stats"]
+        else:
+            variables = {**variables, "params": restored}
 
     @jax.jit
     def eval_batch(imgs, labs):
@@ -66,16 +111,15 @@ def main(argv=None) -> int:
 
     totals = {"top1": 0, "top5": 0, "count": 0}
     cm_total = np.zeros((args.num_classes, args.num_classes), np.int64)
-    n = (len(images) // args.batch) * args.batch
-    for start in range(0, n, args.batch):
-        counts, cm = eval_batch(
-            jnp.asarray(images[start:start + args.batch]),
-            jnp.asarray(labels[start:start + args.batch]))
+    for imgs, labs in batches():
+        counts, cm = eval_batch(jnp.asarray(imgs), jnp.asarray(labs))
         for k in totals:
             totals[k] += int(counts[k])
         cm_total += np.asarray(cm)
+    if totals["count"] == 0:
+        raise SystemExit("no samples evaluated (empty dataset?)")
 
-    count = max(totals["count"], 1)
+    count = totals["count"]
     stats = miou_from_confusion(cm_total)
     results = {
         "top1": totals["top1"] / count,

@@ -305,6 +305,62 @@ def run_stereo(cfg: TaskConfig) -> int:
     return 0 if np.isfinite(last) else 1
 
 
+def run_stereo_online(cfg: TaskConfig) -> int:
+    """MAD online adaptation (Stereo_Online_Adaptation.py modes): per
+    'frame', sample a subset of blocks with the reward-softmax sampler
+    and backprop only through them (grad mask)."""
+    import optax
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.models.stereo.madnet import (MADSampler,
+                                                       photometric_loss)
+
+    s = max(cfg.model.image_size, 64)
+    rng = np.random.default_rng(cfg.train.seed)
+    base = rng.normal(0, 1, (max(cfg.data.batch, 1), s, s, 3)).astype(
+        np.float32)
+    left = jnp.asarray(base)
+    right = jnp.asarray(np.roll(base, -3, axis=2))
+
+    model = MODELS.build(cfg.model.name or "madnet", dtype=jnp.float32)
+    params = model.init(jax.random.key(0), left, right)["params"]
+    tx = optax.adam(cfg.train.lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, mask):
+        def lf(p):
+            out = model.apply({"params": p}, left, right)
+            return photometric_loss(left, right, out["disparity"])
+        loss, g = jax.value_and_grad(lf)(params)
+        g = jax.tree.map(lambda gg, m: gg * m, g, mask)
+        up, opt = tx.update(g, opt, params)
+        # mask the UPDATE too: Adam's momentum would otherwise keep
+        # moving deselected blocks for many frames after selection
+        up = jax.tree.map(lambda u, m: u * m, up, mask)
+        return optax.apply_updates(params, up), opt, loss
+
+    sampler = MADSampler(list(params), sample_n=2, mode="probabilistic",
+                         seed=cfg.train.seed)
+    first = last = None
+    for i in range(cfg.train.steps):
+        selected = sampler.sample()
+        mask = sampler.grad_mask(params, selected)
+        params, opt, loss = step(params, opt, mask)
+        last = float(loss)
+        sampler.update(selected, last)
+        if first is None:
+            first = last
+        if i % max(cfg.train.steps // 5, 1) == 0:
+            print(f"frame {i}: loss={last:.4f} blocks={selected}",
+                  flush=True)
+    if last is None:
+        print("no steps run")
+        return 1
+    print(f"loss {first:.4f} -> {last:.4f}")
+    print(f"task_metric photometric_online={last:.4f}")
+    return 0 if np.isfinite(last) else 1
+
+
 RUNNERS = {
     "segmentation": run_segmentation,
     "mae": run_mae,
@@ -312,6 +368,7 @@ RUNNERS = {
     "metric": run_metric,
     "keypoints": run_keypoints,
     "stereo": run_stereo,
+    "stereo_online": run_stereo_online,
 }
 
 
