@@ -46,11 +46,23 @@ def flash_hb_adapter(q, k, v, dropout_rate: float = 0.0,
     return t(flash_attention_hb(t(q), t(k), t(v)))
 
 
+def sdpa_adapter(q, k, v, dropout_rate: float = 0.0,
+                 deterministic: bool = True,
+                 rng: Optional[jax.Array] = None):
+    """(B, N, H, D) adapter over jax.nn.dot_product_attention — the
+    XLA-native SDPA entry (can lower to a fused attention)."""
+    _check_no_dropout(dropout_rate, deterministic)
+    del rng
+    return jax.nn.dot_product_attention(q, k, v)
+
+
 def get_attn_fn(name: str = "flash") -> Optional[Callable]:
     if name in ("flash", "pallas"):
         return flash_attn_adapter
     if name in ("flash_hb", "pallas_hb", "head_batched"):
         return flash_hb_adapter
+    if name in ("sdpa", "xla"):
+        return sdpa_adapter
     if name in ("naive", "lax", "reference"):
         return None  # models fall back to their built-in naive path
     raise ValueError(f"Unknown attention implementation {name!r}")

@@ -42,9 +42,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       attn_fn: Optional[Callable] = None) -> jax.Array:
     """Must run inside shard_map with ``axis_name`` bound; q/k/v are the
     device-local sequence chunks (B, H, N/P, D) with H divisible by the
-    axis size. ``attn_fn(q, k, v)`` sees (B, H/P, N, D) full-sequence
-    blocks (default: softmax attention; pass the Pallas flash kernel for
-    fused long-context blocks)."""
+    axis size. ``attn_fn`` sees (B, H/P, N, D) full-sequence blocks
+    (default: softmax attention; pass the Pallas flash kernel for fused
+    long-context blocks). If it accepts an ``sm_scale`` keyword the
+    scale is forwarded; plain ``attn_fn(q, k, v)`` callables are allowed
+    only with the default scale."""
     p_size = jax.lax.axis_size(axis_name)
     b, h, nl, d = q.shape
     if h % p_size:
@@ -65,9 +67,21 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if attn_fn is None:
         out = _default_attention(qh, kh, vh, sm_scale)
     else:
-        # attn_fn must accept sm_scale — forwarded so an explicit scale
-        # is never silently dropped (flash_attention takes it as kw)
-        out = attn_fn(qh, kh, vh, sm_scale=sm_scale)
+        # forward sm_scale when the fn accepts it (flash_attention does)
+        # so an explicit scale is never silently dropped; plain
+        # attn_fn(q, k, v) callables still work with the default scale
+        import inspect
+        try:
+            takes_scale = "sm_scale" in inspect.signature(
+                attn_fn).parameters
+        except (TypeError, ValueError):
+            takes_scale = False
+        if not takes_scale and sm_scale != q.shape[-1] ** -0.5:
+            raise ValueError(
+                "explicit sm_scale given but attn_fn does not accept an "
+                "sm_scale keyword — it would be silently ignored")
+        out = (attn_fn(qh, kh, vh, sm_scale=sm_scale) if takes_scale
+               else attn_fn(qh, kh, vh))
     return gather_heads(out.astype(q.dtype))
 
 

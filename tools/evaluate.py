@@ -79,11 +79,17 @@ def main(argv=None) -> int:
         if len(class_to_idx) != args.num_classes:
             ap.error(f"--num-classes {args.num_classes} but folder has "
                      f"{len(class_to_idx)} classes")
+        if len(val_loader) == 0:
+            raise SystemExit(
+                "empty val split — raise --val-rate or add images")
 
         def batches():
             for batch in val_loader:
                 yield (batch["image"], batch["label"])
-        sample = next(iter(val_loader))["image"][:1]
+        # init shape is fully determined by --image-size; no need to
+        # decode a real batch just for model.init
+        sample = np.zeros((1, args.image_size, args.image_size, 3),
+                          np.float32)
     model = MODELS.build(args.model, num_classes=args.num_classes)
     variables = model.init(jax.random.key(0),
                            jnp.asarray(sample), train=False)

@@ -50,14 +50,8 @@ def main():
 
     attn_fn = None
     if args.attn == "sdpa":
-        # jax.nn.dot_product_attention takes (B, N, H, D) directly — the
-        # XLA-native SDPA entry that can lower to a fused attention
-        def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True,
-                    rng=None):
-            if dropout_rate > 0.0 and not deterministic:
-                raise NotImplementedError(
-                    "sdpa variant has no attention dropout")
-            return jax.nn.dot_product_attention(q, k, v)
+        from deeplearning_tpu.ops.attention import sdpa_adapter
+        attn_fn = sdpa_adapter
     elif args.attn == "flash":
         from deeplearning_tpu.ops.attention import flash_attn_adapter
         attn_fn = flash_attn_adapter

@@ -6,7 +6,7 @@ flush so partial progress survives a tunnel death).
 
 Stages:
   1. health probe (fails fast if the tunnel is wedged)
-  2. ViT-B/16 train-step MFU: naive vs flash vs flash_hb attention
+  2. ViT-B/16 train-step MFU: naive vs XLA-SDPA vs flash_hb attention
   3. attention kernel microbench fwd+bwd at ViT + long-context shapes
   4. Swin-B window-attention: fused kernel vs lax path
 
@@ -40,12 +40,14 @@ def stage1_probe():
 def stage2_train_steps():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from perf_sweep import time_variant
-    from deeplearning_tpu.ops.attention import (flash_attn_adapter,
-                                                flash_hb_adapter)
+    from deeplearning_tpu.ops.attention import flash_hb_adapter
+
+    from deeplearning_tpu.ops.attention import sdpa_adapter
+
     results = {}
     for name, fn in [("naive", None),
-                     ("flash_hb", flash_hb_adapter),
-                     ("flash", flash_attn_adapter)]:
+                     ("sdpa", sdpa_adapter),
+                     ("flash_hb", flash_hb_adapter)]:
         try:
             dt, mfu = time_variant(f"vit_train_{name}", 128, attn_fn=fn)
             results[name] = mfu
@@ -68,10 +70,15 @@ def stage3_attn_micro():
         t = lambda x: x.transpose(0, 2, 1, 3)
         return t(dot_product_attention(t(q), t(k), t(v)))
 
+    def jax_flash(q, k, v):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jf)
+        return jf(q, k, v, sm_scale=q.shape[-1] ** -0.5)
+
     shapes = [(128, 12, 197, 64), (128, 16, 50, 80),
               (8, 12, 1024, 64), (2, 12, 4096, 64), (1, 12, 8192, 64)]
     variants = {"naive": naive_bhnd, "flash": flash_attention,
-                "flash_hb": flash_attention_hb}
+                "flash_hb": flash_attention_hb, "jax_flash": jax_flash}
     for shape in shapes:
         rng = np.random.default_rng(0)
         q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
