@@ -12,6 +12,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 @pytest.mark.parametrize("name,extra", [
     ("yolox_nano", ["train.multiscale=true"]),
+    ("yolov5s", []),
     ("fcos_resnet18_fpn", []),
     ("fasterrcnn_resnet18_fpn", []),
 ])

@@ -156,6 +156,29 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
                                      score_thresh=score_thresh)
         return loss_fn, predict_fn
 
+    if name.startswith("yolov5"):
+        from deeplearning_tpu.models.detection.yolov5 import (
+            yolov5_grid, yolov5_loss, yolov5_postprocess)
+
+        def loss_fn(params, stats, batch, rng):
+            hw = batch["image"].shape[1:3]
+            grid = {k: jnp.asarray(v)
+                    for k, v in yolov5_grid(hw).items()}
+            out, new_stats = apply_train(params, stats, batch["image"])
+            l = yolov5_loss(out, grid, batch["boxes"], batch["labels"],
+                            batch["valid"], num_classes=num_classes)
+            return (l["box_loss"] + l["obj_loss"] + l["cls_loss"],
+                    new_stats)
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            grid = {k: jnp.asarray(v)
+                    for k, v in yolov5_grid(hw).items()}
+            out = apply_eval(params, stats, images)
+            return yolov5_postprocess(out, grid, max_det=10,
+                                      score_thresh=score_thresh)
+        return loss_fn, predict_fn
+
     if name.startswith("fcos"):
         from deeplearning_tpu.models.detection.fcos import (
             fcos_locations, fcos_loss, fcos_postprocess, fcos_targets)
