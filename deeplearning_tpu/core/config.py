@@ -182,8 +182,14 @@ def save_config(cfg: Any, path: str) -> None:
 def pop_flag(argv: list, name: str) -> Optional[str]:
     """Extract ``name VALUE`` or ``name=VALUE`` from argv in place and
     return the value (None if absent). For CLI flags that must be read
-    before config_cli's argparse (e.g. --exp / --task selectors)."""
+    before config_cli's argparse (e.g. --exp / --task selectors).
+
+    The scan stops at a literal ``--`` separator so a matching token that
+    is merely another flag's VALUE can be protected: put it after ``--``.
+    The selector flag itself must therefore precede any ``--``."""
     for i, a in enumerate(argv):
+        if a == "--":
+            return None
         if a == name:
             if i + 1 >= len(argv):
                 raise SystemExit(f"{name} requires a value")

@@ -63,11 +63,15 @@ def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
 
 
 def decode_resize_batch(blobs: List[bytes], out_h: int, out_w: int,
-                        n_threads: int = 4) -> Optional[np.ndarray]:
+                        n_threads: int = 4,
+                        strict: bool = False) -> Optional[np.ndarray]:
     """List of JPEG byte strings -> (N, out_h, out_w, 3) uint8, decoded
     and bilinear-resized by a C++ thread pool (GIL released for the whole
-    batch). Failed decodes come back as zero images; returns None only if
-    the native lib is unavailable."""
+    batch). Returns None only if the native lib is unavailable.
+
+    Failed decodes come back as zero images. The C worker reports how many
+    failed: with ``strict=True`` any failure raises; otherwise a warning
+    is logged so corrupt inputs can't silently poison a training batch."""
     lib = _lib()
     if lib is None:
         return None
@@ -77,7 +81,15 @@ def decode_resize_batch(blobs: List[bytes], out_h: int, out_w: int,
         return out
     bufs = (ctypes.c_char_p * n)(*blobs)
     lens = (ctypes.c_long * n)(*[len(b) for b in blobs])
-    lib.decode_resize_batch(
+    n_errors = lib.decode_resize_batch(
         bufs, lens, n, out_h, out_w,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
+    if n_errors:
+        if strict:
+            raise ValueError(
+                f"decode_resize_batch: {n_errors}/{n} JPEG decodes failed")
+        import logging
+        logging.getLogger(__name__).warning(
+            "decode_resize_batch: %d/%d JPEG decodes failed "
+            "(zero-filled in output)", n_errors, n)
     return out

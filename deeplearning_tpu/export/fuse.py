@@ -58,7 +58,8 @@ def _get(tree: Dict, path: Sequence[str]) -> Dict:
 def fuse_conv_bn(variables: Dict, *,
                  pairs: Optional[Sequence[Tuple[Sequence[str],
                                                 Sequence[str]]]] = None,
-                 eps=1e-5) -> Dict:
+                 eps=1e-5,
+                 verify=None, verify_tol: float = 1e-3) -> Dict:
     """Return new ``{"params", "batch_stats"}`` with every detected
     (conv, bn) pair folded. Shapes and tree structure are unchanged, so
     the result applies through the original module with ``train=False``.
@@ -67,7 +68,14 @@ def fuse_conv_bn(variables: Dict, *,
     multiplier and the identity-BN rewrite depend on it, so a mismatch
     (e.g. fusing an eps=1e-3 model with the 1e-5 default) mis-scales
     every fused layer. Pass a callable ``eps('/'.join(bn_path)) -> float``
-    for models mixing epsilons."""
+    for models mixing epsilons.
+
+    ``verify``: optional ``f(variables) -> array`` (typically a closure
+    over ``model.apply(..., train=False)`` on a probe batch). When given,
+    the fused tree is applied through it and compared against the
+    original's output; a max abs deviation above ``verify_tol`` raises —
+    catching exactly the silent mis-pairing / wrong-epsilon failure the
+    naming convention can't."""
     import jax
 
     params = jax.tree_util.tree_map(lambda x: x, variables["params"])
@@ -103,4 +111,15 @@ def fuse_conv_bn(variables: Dict, *,
         st["mean"] = jnp.zeros_like(mean)
         st["var"] = jnp.zeros_like(var)
 
-    return {"params": params, "batch_stats": stats}
+    fused = {"params": params, "batch_stats": stats}
+    if verify is not None:
+        import numpy as np
+        ref = np.asarray(verify(variables), jnp.float32)
+        got = np.asarray(verify(fused), jnp.float32)
+        dev = float(np.max(np.abs(ref - got)))
+        if not np.isfinite(dev) or dev > verify_tol:
+            raise ValueError(
+                f"fuse_conv_bn self-check failed: max|orig-fused|={dev:.3e} "
+                f"> tol={verify_tol:.1e} — wrong epsilon or mis-paired "
+                f"conv/bn (pass explicit pairs= or eps=)")
+    return fused

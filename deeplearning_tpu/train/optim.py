@@ -109,8 +109,14 @@ def build_optimizer(name: str, schedule, clip_grad_norm: Optional[float] = None,
     if freeze:
         if params is None:
             raise ValueError("freeze patterns require params to build the mask")
-        # zero the FINAL updates (not the grads): decoupled weight decay
-        # would otherwise still move frozen params
+        mask = freeze_mask(params, freeze)
+        # Zero frozen grads BEFORE the clip so the global norm only counts
+        # trainable params (requires_grad=False semantics: frozen grads don't
+        # exist, so they must not shrink everyone else's clip budget), and
+        # zero the FINAL updates AFTER the optimizer: decoupled weight decay
+        # would otherwise still move frozen params.
         tx = optax.chain(
-            tx, optax.masked(optax.set_to_zero(), freeze_mask(params, freeze)))
+            optax.masked(optax.set_to_zero(), mask),
+            tx,
+            optax.masked(optax.set_to_zero(), mask))
     return tx

@@ -54,3 +54,20 @@ def test_yaml_file_merge(tmp_path):
     assert cfg.inner.lr == 0.5
     assert cfg.inner.name == "adamw"
     assert cfg.inner.steps == 7
+
+
+def test_pop_flag_basic_and_separator():
+    from deeplearning_tpu.core.config import pop_flag
+
+    argv = ["--task", "cls", "lr", "3e-4"]
+    assert pop_flag(argv, "--task") == "cls"
+    assert argv == ["lr", "3e-4"]
+
+    argv = ["--exp=yolox_s", "x"]
+    assert pop_flag(argv, "--exp") == "yolox_s"
+    assert argv == ["x"]
+
+    # tokens after a literal `--` are values, never selector flags
+    argv = ["--name", "--", "--task", "literal"]
+    assert pop_flag(argv, "--task") is None
+    assert argv == ["--name", "--", "--task", "literal"]

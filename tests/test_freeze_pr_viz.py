@@ -53,6 +53,24 @@ class TestFreeze:
         # unfrozen params do get updates (incl. decoupled decay)
         assert float(jnp.abs(updates["head"]["fc"]["kernel"]).max()) > 0.0
 
+    def test_frozen_grads_excluded_from_clip_norm(self):
+        # requires_grad=False semantics: a huge gradient on a frozen param
+        # must not eat the trainable params' global-norm clip budget.
+        params = self._params()
+        sched = build_schedule("constant", base_lr=1.0)
+        tx = build_optimizer("sgd", sched, momentum=0.0, clip_grad_norm=1.0,
+                             params=params, freeze=("backbone",))
+        grads = jax.tree.map(jnp.zeros_like, params)
+        grads["backbone"]["conv1"]["kernel"] = \
+            jnp.full((3, 3, 4, 8), 1e6)  # enormous frozen grad
+        grads["head"]["fc"]["kernel"] = jnp.full((8, 2), 0.1)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        # trainable grad norm (0.4) is under the clip=1.0 → unscaled step
+        np.testing.assert_allclose(
+            np.asarray(updates["head"]["fc"]["kernel"]), -0.1, rtol=1e-5)
+        assert float(jnp.abs(updates["backbone"]["conv1"]["kernel"]).max()) \
+            == 0.0
+
 
 class TestPRCurve:
     def test_perfect_detector_ap_one(self):

@@ -77,6 +77,16 @@ class TestBatchResize:
         out = decode_resize_batch(blobs, 8, 8)
         assert out[1].sum() == 0 and out[0].sum() > 0
 
+    def test_failed_decode_warns_and_strict_raises(self, caplog):
+        import logging
+        import pytest
+        blobs = [_jpeg_bytes(_rand_img(16, 16)), b"garbage"]
+        with caplog.at_level(logging.WARNING):
+            decode_resize_batch(blobs, 8, 8)
+        assert any("1/2" in r.getMessage() for r in caplog.records)
+        with pytest.raises(ValueError, match="1/2 JPEG decodes failed"):
+            decode_resize_batch(blobs, 8, 8, strict=True)
+
     def test_empty_batch(self):
         assert decode_resize_batch([], 8, 8).shape == (0, 8, 8, 3)
 

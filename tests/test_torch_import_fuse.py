@@ -114,3 +114,10 @@ def test_fuse_conv_bn_resnet18_parity():
             fused["batch_stats"])
         if path[-1].key == "var" and float(jnp.abs(leaf).max()) == 0.0)
     assert n_fused >= 20  # resnet18: stem + 8 blocks * 2 + downsamples
+
+    # self-check hook: passes with the right eps, raises on a wrong one
+    verify = lambda v: model.apply(v, x, train=False)
+    fuse_conv_bn(variables, verify=verify)
+    import pytest
+    with pytest.raises(ValueError, match="self-check failed"):
+        fuse_conv_bn(variables, eps=10.0, verify=verify)
