@@ -467,6 +467,16 @@ def yolox_postprocess(raw: jax.Array, centers: jax.Array,
                       nms_thresh: float = 0.65, max_det: int = 100
                       ) -> Dict[str, jax.Array]:
     decoded = decode_outputs(raw, centers, strides)
+    return postprocess_decoded(decoded, score_thresh=score_thresh,
+                               nms_thresh=nms_thresh, max_det=max_det)
+
+
+def postprocess_decoded(decoded: jax.Array, score_thresh: float = 0.01,
+                        nms_thresh: float = 0.65, max_det: int = 100
+                        ) -> Dict[str, jax.Array]:
+    """NMS postprocess over already-decoded (B, A, 5+C) predictions —
+    split out of yolox_postprocess so TTA can merge several decoded
+    variants (multi-scale/flip) along A and run ONE suppression pass."""
 
     def per_image(dec):
         obj = jax.nn.sigmoid(dec[:, 4])

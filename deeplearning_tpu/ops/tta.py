@@ -1,0 +1,112 @@
+"""Test-time augmentation (TTA) for inference.
+
+Reference surface: yolov5's augmented inference — ``Model.forward_augment``
+runs the net at scales (1, 0.83, 0.67) with a horizontal flip on the
+second, de-scales each prediction set back to the input frame
+(``models/yolo.py:183-244`` forward_augment/_descale_pred/_clip_augmented)
+and concatenates before ONE non_max_suppression; plus the classification
+flip-averaging idiom used across the zoo's predict scripts.
+
+TPU-first formulation: every (scale, flip) variant is a *static* shape —
+each runs as its own jit-compiled forward (same bucketed-static-shapes
+policy as multi-scale training, data/multiscale.py), predictions are
+de-scaled with pure array ops, merged along the anchor axis, and a single
+fixed-shape padded NMS (ops/nms.py) suppresses across variants. No
+dynamic shapes anywhere, so XLA caches one executable per scale.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flip_lr_boxes", "descale_boxes", "classify_tta", "yolox_tta"]
+
+
+def flip_lr_boxes(boxes: jax.Array, width: float) -> jax.Array:
+    """Mirror xyxy boxes horizontally inside an image of ``width``."""
+    x1 = width - boxes[..., 2]
+    x2 = width - boxes[..., 0]
+    return jnp.stack([x1, boxes[..., 1], x2, boxes[..., 3]], axis=-1)
+
+
+def descale_boxes(boxes: jax.Array, scale, flip_lr: bool,
+                  width: float) -> jax.Array:
+    """Map xyxy boxes predicted in a scaled(+flipped) frame back to the
+    base frame (yolov5 _descale_pred, models/yolo.py:229: divide by the
+    scale gain; un-mirror x for lr flips). ``width`` is the AUGMENTED
+    frame's width (un-flip happens before un-scaling). ``scale`` is a
+    float or an (sx, sy) pair when divisor rounding made the horizontal
+    and vertical gains differ."""
+    if flip_lr:
+        boxes = flip_lr_boxes(boxes, width)
+    sx, sy = scale if isinstance(scale, (tuple, list)) else (scale, scale)
+    return boxes / jnp.asarray([sx, sy, sx, sy], boxes.dtype)
+
+
+def classify_tta(logits_fn: Callable[[jax.Array], jax.Array],
+                 images: jax.Array,
+                 flip: bool = True,
+                 extra_views: Sequence[Callable[[jax.Array], jax.Array]] = ()
+                 ) -> jax.Array:
+    """Average class PROBABILITIES over augmented views of NHWC images:
+    identity + horizontal flip (+ caller-supplied view transforms).
+    Returns the averaged probabilities. Softmax-then-mean (not
+    logit-mean) matches the ensemble semantics of the reference's
+    predict scripts."""
+    views = [lambda x: x]
+    if flip:
+        views.append(lambda x: x[:, :, ::-1, :])
+    views.extend(extra_views)
+    return sum(jax.nn.softmax(logits_fn(v(images)), axis=-1)
+               for v in views) / len(views)
+
+
+def yolox_tta(raw_fn: Callable[[jax.Array], jax.Array],
+              images: jax.Array,
+              scales: Sequence[float] = (1.0, 0.83, 0.67),
+              flips: Sequence[bool] = (False, True, False),
+              size_divisor: int = 32,
+              score_thresh: float = 0.01,
+              nms_thresh: float = 0.65,
+              max_det: int = 100,
+              grid_fn=None,
+              decode_fn=None) -> Dict[str, jax.Array]:
+    """Multi-scale + flip TTA for the YOLOX family.
+
+    ``raw_fn(images) -> (B, A, 5+C)`` is the model forward (apply bound
+    with variables). Each (scale, flip) pair resizes the NHWC batch to a
+    ``size_divisor``-aligned static shape, runs the forward, decodes on
+    that scale's own anchor grid, de-scales boxes to the base frame, then
+    every variant's decoded predictions are concatenated along A and
+    suppressed by one fixed-shape NMS — the TPU analog of yolov5
+    forward_augment (scales/flips defaults match models/yolo.py:185-186).
+    """
+    from ..models.detection.yolox import (decode_outputs, postprocess_decoded,
+                                          yolox_grid)
+    grid_fn = grid_fn or yolox_grid
+    decode_fn = decode_fn or decode_outputs
+
+    b, h, w, c = images.shape
+    merged = []
+    for scale, flip in zip(scales, flips):
+        sh = max(size_divisor,
+                 int(round(h * scale / size_divisor)) * size_divisor)
+        sw = max(size_divisor,
+                 int(round(w * scale / size_divisor)) * size_divisor)
+        view = images
+        if (sh, sw) != (h, w):
+            view = jax.image.resize(view, (b, sh, sw, c), "bilinear")
+        if flip:
+            view = view[:, :, ::-1, :]
+        raw = raw_fn(view)
+        centers, strides = grid_fn((sh, sw))
+        dec = decode_fn(raw, jnp.asarray(centers), jnp.asarray(strides))
+        boxes = descale_boxes(dec[..., :4], (sw / w, sh / h), flip,
+                              float(sw))
+        merged.append(jnp.concatenate([boxes, dec[..., 4:]], axis=-1))
+    decoded = jnp.concatenate(merged, axis=1)
+    return postprocess_decoded(decoded, score_thresh=score_thresh,
+                               nms_thresh=nms_thresh, max_det=max_det)

@@ -1,0 +1,147 @@
+"""TTA inference and genetic hyperparameter evolution.
+
+References: yolov5 models/yolo.py:183-244 (forward_augment/_descale_pred),
+train.py:637-716 (--evolve loop), utils/metrics.py:15 (fitness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.ops.tta import (classify_tta, descale_boxes,
+                                      flip_lr_boxes, yolox_tta)
+from deeplearning_tpu.train.evolve import (DETECTION_META, best_hyp,
+                                           det_fitness, evolve, mutate)
+
+
+class TestDescale:
+    def test_flip_roundtrip(self):
+        boxes = jnp.asarray([[10.0, 5.0, 30.0, 25.0]])
+        flipped = flip_lr_boxes(boxes, 100.0)
+        np.testing.assert_allclose(np.asarray(flipped),
+                                   [[70.0, 5.0, 90.0, 25.0]])
+        back = flip_lr_boxes(flipped, 100.0)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(boxes))
+
+    def test_descale_inverts_scale_and_flip(self):
+        base = np.array([[40.0, 16.0, 80.0, 48.0]], np.float32)
+        # forward transform: scale by 0.5 into a 64-wide frame, then flip
+        scaled = base * 0.5
+        aug = np.asarray(flip_lr_boxes(jnp.asarray(scaled), 64.0))
+        out = descale_boxes(jnp.asarray(aug), 0.5, True, 64.0)
+        np.testing.assert_allclose(np.asarray(out), base, rtol=1e-6)
+
+    def test_descale_anisotropic(self):
+        base = np.array([[10.0, 20.0, 30.0, 60.0]], np.float32)
+        aug = base * np.array([0.5, 0.25, 0.5, 0.25])
+        out = descale_boxes(jnp.asarray(aug), (0.5, 0.25), False, 0.0)
+        np.testing.assert_allclose(np.asarray(out), base, rtol=1e-6)
+
+
+class TestClassifyTTA:
+    def test_flip_average_changes_asymmetric_logits(self):
+        # logits_fn keyed on image content: mean over W-halves
+        def logits_fn(x):
+            left = x[:, :, : x.shape[2] // 2].mean((1, 2, 3))
+            right = x[:, :, x.shape[2] // 2:].mean((1, 2, 3))
+            return jnp.stack([left, right], -1)
+
+        img = jnp.zeros((1, 4, 4, 1)).at[:, :, :2].set(1.0)
+        p = np.asarray(classify_tta(logits_fn, img, flip=True))
+        assert p.shape == (1, 2)
+        # flip symmetrizes: both classes get identical probability
+        np.testing.assert_allclose(p[0, 0], p[0, 1], rtol=1e-5)
+        np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-5)
+
+    def test_no_flip_is_plain_softmax(self):
+        logits_fn = lambda x: jnp.asarray([[2.0, 0.0]])
+        out = classify_tta(logits_fn, jnp.zeros((1, 2, 2, 1)), flip=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(jax.nn.softmax(
+                jnp.asarray([[2.0, 0.0]]))), rtol=1e-5)
+
+
+class TestYoloxTTA:
+    def _model(self):
+        from deeplearning_tpu.core.registry import MODELS
+        model = MODELS.build("yolox_nano", num_classes=3,
+                             dtype=jnp.float32)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, 64, 64, 3)), train=False)
+        return model, variables
+
+    def test_identity_tta_matches_plain_postprocess(self):
+        from deeplearning_tpu.models.detection.yolox import (
+            decode_outputs, yolox_grid, yolox_postprocess)
+        model, variables = self._model()
+        img = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 64, 64, 3)), jnp.float32)
+        raw_fn = lambda x: model.apply(variables, x, train=False)
+        tta = yolox_tta(raw_fn, img, scales=(1.0,), flips=(False,),
+                        max_det=10)
+        centers, strides = yolox_grid((64, 64))
+        plain = yolox_postprocess(raw_fn(img), jnp.asarray(centers),
+                                  jnp.asarray(strides), max_det=10)
+        np.testing.assert_allclose(np.asarray(tta["boxes"]),
+                                   np.asarray(plain["boxes"]), rtol=1e-5,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(tta["valid"]),
+                                      np.asarray(plain["valid"]))
+
+    def test_multiscale_flip_tta_shapes_and_jit(self):
+        model, variables = self._model()
+        img = jnp.asarray(np.random.default_rng(1).normal(
+            size=(2, 64, 64, 3)), jnp.float32)
+        raw_fn = lambda x: model.apply(variables, x, train=False)
+        out = jax.jit(lambda im: yolox_tta(
+            raw_fn, im, scales=(1.0, 0.83, 0.67),
+            flips=(False, True, False), max_det=20))(img)
+        assert out["boxes"].shape == (2, 20, 4)
+        assert out["scores"].shape == (2, 20)
+        # de-scaled boxes stay in the base 64x64 frame
+        kept = np.asarray(out["boxes"])[np.asarray(out["valid"])]
+        if kept.size:
+            assert kept.min() > -64 and kept.max() < 128
+
+
+class TestEvolve:
+    def test_mutate_respects_bounds_and_changes(self):
+        rng = np.random.default_rng(0)
+        hyp = {"lr": 0.01, "mosaic": 1.0, "fliplr": 0.5, "extra": 7.0}
+        out = mutate(hyp, DETECTION_META, rng)
+        assert out != hyp
+        assert out["extra"] == 7.0          # not in meta: untouched
+        assert out["fliplr"] == 0.5         # gain 0 gene: never mutates
+        for k in ("lr", "mosaic"):
+            lo, hi = DETECTION_META[k][1], DETECTION_META[k][2]
+            assert lo <= out[k] <= hi
+
+    def test_mutate_no_mutable_genes_returns_unchanged(self):
+        # all-gain-0 (or meta-disjoint) hyps must not hang the retry loop
+        rng = np.random.default_rng(0)
+        assert mutate({"fliplr": 0.5}, DETECTION_META, rng) \
+            == {"fliplr": 0.5}
+        assert mutate({"unknown": 1.0}, DETECTION_META, rng) \
+            == {"unknown": 1.0}
+
+    def test_evolution_improves_toy_fitness(self, tmp_path):
+        # fitness peaks at lr=0.03, mosaic=0.5 — evolution should climb
+        target = {"lr": 0.03, "mosaic": 0.5}
+
+        def eval_fn(hyp):
+            return -sum((hyp[k] - target[k]) ** 2 for k in target)
+
+        path = str(tmp_path / "evolve.jsonl")
+        hyp0 = {"lr": 0.001, "mosaic": 1.0}
+        best = evolve(eval_fn, hyp0, DETECTION_META, generations=40,
+                      records_path=path, seed=0)
+        assert eval_fn(best) > eval_fn(hyp0) + 1e-4
+        assert best == best_hyp(path)
+        # resumable: one more generation appends, doesn't reset
+        best2 = evolve(eval_fn, hyp0, DETECTION_META, generations=1,
+                       records_path=path, seed=1)
+        assert eval_fn(best2) >= eval_fn(best)
+
+    def test_det_fitness_weights(self):
+        assert det_fitness({"ap": 1.0, "ap50": 0.0}) == pytest.approx(0.9)
+        assert det_fitness({"ap": 0.0, "ap50": 1.0}) == pytest.approx(0.1)

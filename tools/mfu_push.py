@@ -114,7 +114,7 @@ def main():
     if os.path.exists(ref_path):
         with open(ref_path) as f:
             ref_cache = json.load(f)
-    if args.attn == "naive" and not args.remat:
+    if args.attn == "naive" and not args.remat and step_flops > 0:
         ref_cache[ref_key] = step_flops / batch
         with open(ref_path, "w") as f:
             json.dump(ref_cache, f)

@@ -53,6 +53,9 @@ def main(argv=None) -> int:
     ap.add_argument("--topk", type=int, default=5)
     ap.add_argument("--classes", default=None,
                     help="json mapping class index -> name")
+    ap.add_argument("--tta", action="store_true",
+                    help="average probabilities over a horizontal-flip "
+                         "view (yolov5 --augment analog)")
     args = ap.parse_args(argv)
 
     from deeplearning_tpu.core.checkpoint import load_pytree
@@ -67,9 +70,15 @@ def main(argv=None) -> int:
         params = restored.get("params", restored) \
             if isinstance(restored, dict) else restored
         variables = {**variables, "params": params}
-    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
-        variables, images)
-    probs = np.asarray(jax.nn.softmax(logits, -1))
+    if args.tta:
+        from deeplearning_tpu.ops.tta import classify_tta
+        probs = np.asarray(jax.jit(lambda v, x: classify_tta(
+            lambda im: model.apply(v, im, train=False), x))(
+            variables, images))
+    else:
+        logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+            variables, images)
+        probs = np.asarray(jax.nn.softmax(logits, -1))
     names = {}
     if args.classes:
         with open(args.classes) as f:

@@ -247,18 +247,11 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
 
 
 def main(argv=None) -> int:
-    import optax
-
-    from deeplearning_tpu.core.config import config_cli
-    from deeplearning_tpu.core.registry import MODELS
-    from deeplearning_tpu.evaluation.coco_eval import CocoEvaluator
-    from deeplearning_tpu.train.multiscale import (MultiScaleSchedule,
-                                                   resize_detection_batch)
-
     # --exp NAME: seed the config DEFAULTS from a registered DetectionExp
     # (exps/default/* analog). Precedence: defaults < exp < yaml < CLI.
-    from deeplearning_tpu.core.config import pop_flag
+    from deeplearning_tpu.core.config import config_cli, pop_flag
     argv = list(sys.argv[1:] if argv is None else argv)
+    evolve_gens = pop_flag(argv, "--evolve")
     exp_name = pop_flag(argv, "--exp")
     defaults = DetConfig()
     if exp_name:
@@ -267,6 +260,45 @@ def main(argv=None) -> int:
         defaults = load_config(
             defaults, None, get_exp(exp_name=exp_name).cli_overrides())
     cfg = config_cli(defaults, argv, description=__doc__)
+
+    if evolve_gens:
+        # yolov5 --evolve analog: short training runs as the fitness
+        # probe, JSONL records in runs/evolve, best hyp printed at the
+        # end. Evolvable genes = the DetTrainCfg fields in the meta.
+        from deeplearning_tpu.train.evolve import (DETECTION_META,
+                                                   det_fitness, evolve)
+
+        def eval_fn(hyp):
+            trial = dataclasses.replace(
+                cfg, train=dataclasses.replace(
+                    cfg.train, lr=hyp["lr"],
+                    clip_grad_norm=hyp["clip_grad_norm"]))
+            return det_fitness(run(trial))
+
+        meta = {"lr": DETECTION_META["lr"],
+                "clip_grad_norm": (1.0, 0.1, 10.0)}
+        best = evolve(eval_fn,
+                      {"lr": cfg.train.lr,
+                       "clip_grad_norm": cfg.train.clip_grad_norm},
+                      meta, int(evolve_gens),
+                      records_path="runs/evolve/detection.jsonl",
+                      seed=cfg.train.seed)
+        print(f"evolve done: best hyp {best}")
+        return 0
+
+    run(cfg)
+    return 0
+
+
+def run(cfg) -> dict:
+    """Train + evaluate one configuration; returns the COCO summary."""
+    import optax
+
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.evaluation.coco_eval import CocoEvaluator
+    from deeplearning_tpu.train.multiscale import (MultiScaleSchedule,
+                                                   resize_detection_batch)
+
     size = cfg.model.image_size
     num_classes = cfg.model.num_classes
     train_src = val_src = None
@@ -406,7 +438,7 @@ def main(argv=None) -> int:
                 det_labels=np.asarray(det["labels"][i])[keep])
     summary = ev.summarize()
     print({k: round(v, 4) for k, v in summary.items()})
-    return 0
+    return summary
 
 
 if __name__ == "__main__":
