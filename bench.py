@@ -113,15 +113,17 @@ def main():
     cost = compiled.cost_analysis()
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
-    # warmup (also materializes donation) then timed steps. Sync by
-    # fetching the scalar loss to host — block_until_ready is unreliable
-    # through remote-tunnel PJRT backends, a D2H fetch always syncs.
-    state, metrics = step(state, data, rng)
+    # warmup (also materializes donation) then timed steps, driving the
+    # compiled executable directly (step() has its own jit cache and
+    # would pay a second identical compile). Sync by fetching the scalar
+    # loss to host — block_until_ready is unreliable through
+    # remote-tunnel PJRT backends, a D2H fetch always syncs.
+    state, metrics = compiled(state, data, rng)
     float(metrics["loss"])
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, data, rng)
+        state, metrics = compiled(state, data, rng)
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
 

@@ -403,12 +403,8 @@ def flash_attention_hb(q, k, v, *, sm_scale=None, causal=False,
     while h % head_block:
         head_block //= 2
     head_block = max(head_block, 1)
-    block_q = min(block_q, _round_block(n))
-    block_k = min(block_k, _round_block(n))
-    n_pad = -n % math.lcm(block_q, block_k)
-    if n_pad:
-        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    block_q, block_k, _, (q, k, v) = _blocks_and_pad(n, block_q, block_k,
+                                                     q, k, v)
     out = _flash_hb(q, k, v, sm_scale, n, causal, block_q, block_k,
                     head_block)
     return out[:, :, :n, :]
@@ -464,11 +460,29 @@ def _flash_bwd(sm_scale, kv_len, causal, block_q, block_k, res, dout):
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta, (b * h, n, 8))
+    dqf, dkf, dvf = _bwd_calls(qf, kf, vf, dof, lse, delta,
+                               sm_scale=sm_scale, kv_len=kv_len,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+    unflat = lambda x: x.reshape(b, h, n, d)
+    return unflat(dqf), unflat(dkf), unflat(dvf)
+
+
+def _bwd_calls(qf, kf, vf, dof, lse, delta, *, sm_scale, kv_len, causal,
+               block_q, block_k, out_dtype=None):
+    """The two backward pallas_calls over flattened (BH, N, D) operands
+    with caller-supplied lse/delta (BH, N, 8). Shared by the plain VJP
+    and by ring attention's chunk backward (which passes the GLOBAL
+    logsumexp/delta so per-chunk gradients sum to the exact full-sequence
+    gradient). ``out_dtype`` overrides the gradients' dtype (the ring
+    accumulates per-chunk grads in f32, so bf16 round trips per ring
+    step would otherwise lose precision)."""
+    bh, n, d = qf.shape
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k,
                           kv_len=kv_len, causal=causal, q_block=block_q),
-        grid=(b * h, n // block_q),
+        grid=(bh, n // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, n, d), lambda bh, qi: (bh, 0, 0)),
@@ -478,7 +492,7 @@ def _flash_bwd(sm_scale, kv_len, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, block_q, 8), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, n, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), out_dtype or qf.dtype),
         interpret=interpret_mode(),
     )(qf, kf, vf, dof, lse, delta)
 
@@ -486,7 +500,7 @@ def _flash_bwd(sm_scale, kv_len, causal, block_q, block_k, res, dout):
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           block_q=block_q, kv_len=kv_len, causal=causal,
                           k_block=block_k),
-        grid=(b * h, n // block_k),
+        grid=(bh, n // block_k),
         in_specs=[
             pl.BlockSpec((1, n, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -500,15 +514,12 @@ def _flash_bwd(sm_scale, kv_len, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, n, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, n, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), out_dtype or kf.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), out_dtype or vf.dtype),
         ],
         interpret=interpret_mode(),
     )(qf, kf, vf, dof, lse, delta)
 
-    dq = dq.reshape(b, h, n, d)
-    dk = dk.reshape(b, h, n, d)
-    dv = dv.reshape(b, h, n, d)
     return dq, dk, dv
 
 
@@ -527,14 +538,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, h, n, d = q.shape
     if sm_scale is None:
         sm_scale = d ** -0.5
-    block_q = min(block_q, _round_block(n))
-    block_k = min(block_k, _round_block(n))
-    n_pad = -n % math.lcm(block_q, block_k)
-    if n_pad:
-        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
+    block_q, block_k, _, (q, k, v) = _blocks_and_pad(n, block_q, block_k,
+                                                     q, k, v)
     out = _flash(q, k, v, sm_scale, n, causal, block_q, block_k)
     return out[:, :, :n, :]
 
@@ -552,15 +557,57 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, h, n, d = q.shape
     if sm_scale is None:
         sm_scale = d ** -0.5
-    block_q = min(block_q, _round_block(n))
-    block_k = min(block_k, _round_block(n))
-    n_pad = -n % math.lcm(block_q, block_k)
-    if n_pad:
-        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    block_q, block_k, n_pad, (q, k, v) = _blocks_and_pad(
+        n, block_q, block_k, q, k, v)
     out, res = _flash_fwd(q, k, v, sm_scale, n, causal, block_q, block_k)
     lse = res[4][:, :, 0].reshape(b, h, n + n_pad)
     return out[:, :, :n, :], lse[:, :, :n]
+
+
+def flash_chunk_grads(q: jax.Array, k: jax.Array, v: jax.Array,
+                      do: jax.Array, lse: jax.Array, delta: jax.Array, *,
+                      sm_scale: Optional[float] = None,
+                      block_q: int = DEFAULT_BLOCK_Q,
+                      block_k: int = DEFAULT_BLOCK_K):
+    """(dq, dk, dv) of attention over ONE KV chunk given the GLOBAL
+    softmax statistics: ``lse``/``delta`` (B, H, Nq) are the full-sequence
+    logsumexp and rowsum(dO·O). Because dS_ij = P_ij·(dP_ij − delta_i)
+    with P taken against the global LSE, per-chunk gradients computed
+    this way sum over chunks to the exact full-attention gradient — this
+    is ring attention's backward building block (Liu & Abbeel, ring
+    attention; same decomposition as FlashAttention-2's dKV pass).
+
+    q/do: (B, H, Nq, D); k/v: (B, H, Nk, D) with Nq == Nk (equal ring
+    chunks). Gradients come back in float32 (the caller accumulates
+    across ring steps)."""
+    b, h, n, d = q.shape
+    if k.shape[2] != n:
+        raise ValueError(f"ring chunks must be equal: Nq={n} "
+                         f"Nk={k.shape[2]}")
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    block_q, block_k, n_pad, (q, k, v, do) = _blocks_and_pad(
+        n, block_q, block_k, q, k, v, do)
+    if n_pad:
+        pad3 = [(0, 0), (0, 0), (0, n_pad)]
+        # padded query rows: do rows are zero, so any finite lse/delta
+        # yields zero contributions to dk/dv (ds == 0, p^T do == 0)
+        lse = jnp.pad(lse, pad3)
+        delta = jnp.pad(delta, pad3)
+    np_ = n + n_pad
+    qf, kf, vf, dof = map(_flatten_bh, (q, k, v, do))
+    lse8 = jnp.broadcast_to(
+        lse.astype(jnp.float32).reshape(b * h, np_, 1), (b * h, np_, 8))
+    delta8 = jnp.broadcast_to(
+        delta.astype(jnp.float32).reshape(b * h, np_, 1), (b * h, np_, 8))
+    # f32 gradients: the ring accumulates per-chunk grads across
+    # axis_size steps — bf16 round trips each step would compound error
+    dqf, dkf, dvf = _bwd_calls(qf, kf, vf, dof, lse8, delta8,
+                               sm_scale=sm_scale, kv_len=n, causal=False,
+                               block_q=block_q, block_k=block_k,
+                               out_dtype=jnp.float32)
+    unflat = lambda x: x.reshape(b, h, np_, d)[:, :, :n, :]
+    return unflat(dqf), unflat(dkf), unflat(dvf)
 
 
 def _round_block(n: int) -> int:
@@ -569,6 +616,19 @@ def _round_block(n: int) -> int:
     while b > 8 and b > n:
         b //= 2
     return max(b, 8)
+
+
+def _blocks_and_pad(n, block_q, block_k, *arrays):
+    """Clamp block sizes to the sequence and zero-pad every (B, H, N, D)
+    array along N to the blocks' lcm. Returns (block_q, block_k, n_pad,
+    padded_arrays) — the one place the padding policy lives."""
+    block_q = min(block_q, _round_block(n))
+    block_k = min(block_k, _round_block(n))
+    n_pad = -n % math.lcm(block_q, block_k)
+    if n_pad:
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        arrays = tuple(jnp.pad(t, pad) for t in arrays)
+    return block_q, block_k, n_pad, arrays
 
 
 def flash_attention_bnhd(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -65,11 +65,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (flash_attention_with_lse): a chunk's (out, lse) is an equivalent
     online-softmax accumulator (num=out, m=lse, l=1), so the ring merge
     is exact and never materializes a (Nlocal, Nlocal) score matrix in
-    HBM. Forward-only — the default lax path stays differentiable.
+    HBM. TRAINABLE: a custom VJP runs a second ring in the backward pass
+    where each device computes per-chunk (dq, dk, dv) with the flash
+    backward kernels against the GLOBAL logsumexp, rotating the dK/dV
+    accumulators with the KV chunks (Liu & Abbeel ring attention bwd).
     """
-    axis_size = jax.lax.axis_size(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if use_flash:
+        return _ring_flash(axis_name, sm_scale, q, k, v)
+    out, _ = _ring_forward(q, k, v, axis_name, sm_scale, use_flash=False)
+    return out
+
+
+def _pvary(tree, axis_name):
+    """Mark zero accumulators as device-varying over the ring axis so
+    fori_loop carry types match the loop body's output types."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.tree.map(
+            lambda x: jax.lax.pcast(x, axis_name, to="varying"), tree)
+    return jax.tree.map(lambda x: jax.lax.pvary(x, (axis_name,)), tree)
+
+
+def _ring_forward(q, k, v, axis_name, sm_scale, use_flash):
+    """Ring forward; returns (out, global_lse)."""
+    axis_size = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def chunk_stats(q, kk, vv):
@@ -90,19 +110,61 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return carry, kk, vv
 
     b, h, nq, d = q.shape
-    init = (jnp.zeros((b, h, nq, d), jnp.float32),
-            jnp.full((b, h, nq), -jnp.inf, jnp.float32),
-            jnp.zeros((b, h, nq), jnp.float32))
-    # mark the zero accumulators as device-varying over the ring axis so
-    # the fori_loop carry type matches the loop body's output type
-    if hasattr(jax.lax, "pcast"):
-        init = jax.tree.map(
-            lambda x: jax.lax.pcast(x, axis_name, to="varying"), init)
-    else:
-        init = jax.tree.map(lambda x: jax.lax.pvary(x, (axis_name,)), init)
+    init = _pvary((jnp.zeros((b, h, nq, d), jnp.float32),
+                   jnp.full((b, h, nq), -jnp.inf, jnp.float32),
+                   jnp.zeros((b, h, nq), jnp.float32)), axis_name)
     (num, m, l), _, _ = jax.lax.fori_loop(
         0, axis_size, body, (init, k, v))
-    return (num / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (num / l_safe[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_flash(axis_name, sm_scale, q, k, v):
+    out, _ = _ring_flash_fwd(axis_name, sm_scale, q, k, v)
+    return out
+
+
+def _ring_flash_fwd(axis_name, sm_scale, q, k, v):
+    out, lse = _ring_forward(q, k, v, axis_name, sm_scale, use_flash=True)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, sm_scale, res, dout):
+    """Backward ring: per-chunk flash gradients against the global LSE
+    sum to the exact full-sequence gradient (flash_chunk_grads
+    docstring), so dQ accumulates locally while (KV, dK, dV) rotate
+    together — after a full circle the dK/dV accumulators are home with
+    every device's contribution."""
+    from ..ops.pallas.flash_attention import flash_chunk_grads
+
+    q, k, v, out, lse = res
+    axis_size = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+
+    def body(i, state):
+        dq, kk, vv, dkk, dvv = state
+        dq_c, dk_c, dv_c = flash_chunk_grads(q, kk, vv, dout, lse, delta,
+                                             sm_scale=sm_scale)
+        dq = dq + dq_c.astype(jnp.float32)
+        dkk = dkk + dk_c.astype(jnp.float32)
+        dvv = dvv + dv_c.astype(jnp.float32)
+        kk, vv, dkk, dvv = (jax.lax.ppermute(t, axis_name, perm)
+                            for t in (kk, vv, dkk, dvv))
+        return dq, kk, vv, dkk, dvv
+
+    zeros = _pvary((jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32),
+                    jnp.zeros(v.shape, jnp.float32)), axis_name)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, body, (zeros[0], k, v, zeros[1], zeros[2]))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,

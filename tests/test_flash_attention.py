@@ -176,3 +176,31 @@ class TestHeadBatchedBackward:
         for gf, gr in zip(g_hb, g_ref):
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                        atol=5e-4, rtol=5e-4)
+
+
+class TestChunkGrads:
+    def test_single_chunk_equals_full_gradient(self):
+        # flash_chunk_grads with the GLOBAL lse/delta over one chunk that
+        # IS the whole sequence must equal the full attention gradient
+        from deeplearning_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse, flash_chunk_grads)
+        rng = np.random.default_rng(7)
+        b, h, n, d = 1, 2, 96, 16      # not a block multiple → padded
+        q, k, v, do = (jnp.asarray(rng.normal(0, 1, (b, h, n, d)),
+                                   jnp.float32) for _ in range(4))
+        out, lse = flash_attention_with_lse(q, k, v)
+        delta = jnp.sum(do * out, axis=-1)
+        dq, dk, dv = flash_chunk_grads(q, k, v, do, lse, delta)
+
+        def ref_loss(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v) * do)
+
+        rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
+                                   atol=1e-4, rtol=1e-4)
