@@ -149,9 +149,9 @@ def _ring_flash_bwd(axis_name, sm_scale, res, dout):
         dq, kk, vv, dkk, dvv = state
         dq_c, dk_c, dv_c = flash_chunk_grads(q, kk, vv, dout, lse, delta,
                                              sm_scale=sm_scale)
-        dq = dq + dq_c.astype(jnp.float32)
-        dkk = dkk + dk_c.astype(jnp.float32)
-        dvv = dvv + dv_c.astype(jnp.float32)
+        dq = dq + dq_c      # chunk grads are f32 (flash_chunk_grads)
+        dkk = dkk + dk_c
+        dvv = dvv + dv_c
         kk, vv, dkk, dvv = (jax.lax.ppermute(t, axis_name, perm)
                             for t in (kk, vv, dkk, dvv))
         return dq, kk, vv, dkk, dvv
