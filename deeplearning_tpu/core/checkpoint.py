@@ -100,6 +100,34 @@ def load_pytree(path: str, target: Optional[Any] = None) -> Any:
         return ckptr.restore(path)
 
 
+def restore_variables(path: str, init_variables: Dict[str, Any],
+                      prefer_ema: bool = True) -> Dict[str, Any]:
+    """ONE interpretation of an inference checkpoint for every CLI
+    (predict/evaluate/demo previously each re-implemented this
+    differently). Accepts a TrainState-style dict ({params, ema_params?,
+    batch_stats?, ...}) or a bare parameter tree, merges into the
+    model's ``init`` variables, and by default prefers EMA weights —
+    the reference evaluates EMA everywhere it tracks one (YOLOX
+    trainer.py evaluate_and_save_model, yolov5 val). BatchNorm stats
+    come from the checkpoint when present: eval with init-time stats is
+    silently wrong."""
+    restored = load_pytree(path)
+    variables = dict(init_variables)
+    if isinstance(restored, dict) and (
+            "params" in restored or "ema_params" in restored):
+        params = None
+        if prefer_ema:
+            params = restored.get("ema_params")
+        if params is None:
+            params = restored.get("params")
+        variables["params"] = params
+        if restored.get("batch_stats"):
+            variables["batch_stats"] = restored["batch_stats"]
+    else:
+        variables["params"] = restored
+    return variables
+
+
 def surgical_load(
     params: Dict[str, Any],
     pretrained: Dict[str, Any],

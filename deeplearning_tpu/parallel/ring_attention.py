@@ -27,13 +27,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import SEQ_AXIS
 
 
-def _chunk_attention_stats(q, k, v, sm_scale):
+NEG_INF = -1e30
+
+
+def _chunk_attention_stats(q, k, v, sm_scale, kv_mask=None):
     """Un-normalized attention over one KV chunk: returns (numerator,
-    max, sumexp) for online combining. q,k,v: (B, H, Nq, D)/(B, H, Nk, D)."""
+    max, sumexp) for online combining. q,k,v: (B, H, Nq, D)/(B, H, Nk, D).
+    ``kv_mask`` (Nk,) bool marks valid key tokens — padded tokens (ring
+    chunks must divide the global N, so wrappers zero-pad the tail) are
+    excluded from the softmax."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[None, None, None, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)                                  # (B,H,Nq)
     p = jnp.exp(s - m[..., None])
+    if kv_mask is not None:
+        p = p * kv_mask[None, None, None, :].astype(p.dtype)
     l = jnp.sum(p, axis=-1)
     num = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
@@ -54,7 +64,8 @@ def _combine(carry, update):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = SEQ_AXIS,
                    sm_scale: Optional[float] = None,
-                   use_flash: bool = False) -> jax.Array:
+                   use_flash: bool = False,
+                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """Exact attention with K/V ring-rotated over ``axis_name``.
 
     Must run inside shard_map with ``axis_name`` bound; q/k/v are the
@@ -73,8 +84,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if use_flash:
+        if kv_mask is not None:
+            raise NotImplementedError(
+                "kv_mask needs the lax path (the flash kernel masks by "
+                "static kv_len only) — pad to a seq-axis multiple "
+                "instead, or set use_flash=False")
         return _ring_flash(axis_name, sm_scale, q, k, v)
-    out, _ = _ring_forward(q, k, v, axis_name, sm_scale, use_flash=False)
+    out, _ = _ring_forward(q, k, v, axis_name, sm_scale, use_flash=False,
+                           kv_mask=kv_mask)
     return out
 
 
@@ -87,34 +104,38 @@ def _pvary(tree, axis_name):
     return jax.tree.map(lambda x: jax.lax.pvary(x, (axis_name,)), tree)
 
 
-def _ring_forward(q, k, v, axis_name, sm_scale, use_flash):
-    """Ring forward; returns (out, global_lse)."""
+def _ring_forward(q, k, v, axis_name, sm_scale, use_flash,
+                  kv_mask=None):
+    """Ring forward; returns (out, global_lse). ``kv_mask`` (Nlocal,)
+    bool rotates around the ring with its KV chunk (lax path only)."""
     axis_size = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def chunk_stats(q, kk, vv):
+    def chunk_stats(q, kk, vv, mm):
         if use_flash:
             from ..ops.pallas.flash_attention import flash_attention_with_lse
             o, lse = flash_attention_with_lse(q, kk, vv, sm_scale=sm_scale)
             return (o.astype(jnp.float32), lse, jnp.ones_like(lse))
-        return _chunk_attention_stats(q, kk, vv, sm_scale)
+        return _chunk_attention_stats(q, kk, vv, sm_scale, kv_mask=mm)
 
     def body(i, state):
-        carry, kk, vv = state
-        update = chunk_stats(q, kk, vv)
+        carry, kk, vv, mm = state
+        update = chunk_stats(q, kk, vv, mm)
         carry = _combine(carry, update)
         # rotate KV to the next device; last iteration's rotate is wasted
         # but keeps the loop body uniform (XLA overlaps it with compute).
         kk = jax.lax.ppermute(kk, axis_name, perm)
         vv = jax.lax.ppermute(vv, axis_name, perm)
-        return carry, kk, vv
+        if mm is not None:
+            mm = jax.lax.ppermute(mm, axis_name, perm)
+        return carry, kk, vv, mm
 
     b, h, nq, d = q.shape
     init = _pvary((jnp.zeros((b, h, nq, d), jnp.float32),
                    jnp.full((b, h, nq), -jnp.inf, jnp.float32),
                    jnp.zeros((b, h, nq), jnp.float32)), axis_name)
-    (num, m, l), _, _ = jax.lax.fori_loop(
-        0, axis_size, body, (init, k, v))
+    (num, m, l), _, _, _ = jax.lax.fori_loop(
+        0, axis_size, body, (init, k, v, kv_mask))
     l_safe = jnp.maximum(l, 1e-30)
     out = (num / l_safe[..., None]).astype(q.dtype)
     return out, m + jnp.log(l_safe)
@@ -186,3 +207,49 @@ def make_ring_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
         return ring_attention(q, k, v, axis_name, use_flash=use_flash)
 
     return fn
+
+
+def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
+                      use_flash: bool = False):
+    """Ring attention as a model ``attn_fn``: the (B, N, H, D) signature
+    every transformer in the zoo accepts (vit.py Attention, transfg,
+    mae). This is how sequence parallelism drops INTO a model instead of
+    living beside it: build any ViT with
+    ``attn_fn=make_ring_attn_fn(mesh)`` and its attention shards over
+    the ``seq`` axis while the rest of the model stays GSPMD-sharded.
+
+    Token counts rarely divide the seq axis (ViT-B/16 has 197 = 196+cls),
+    so inputs are zero-padded to a multiple and a KV validity mask rides
+    the ring with its chunk (lax path). ``use_flash=True`` requires the
+    unpadded length to divide the axis exactly."""
+    from jax import shard_map
+
+    axis_size = mesh.shape[axis_name]
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, P(axis_name)),
+        out_specs=spec, check_vma=not use_flash)
+    def ring(q, k, v, mask):
+        return ring_attention(q, k, v, axis_name, use_flash=use_flash,
+                              kv_mask=None if use_flash else mask)
+
+    def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
+        if dropout_rate and not deterministic:
+            raise NotImplementedError(
+                "ring attn_fn does not support attention dropout")
+        n = q.shape[1]
+        n_pad = -n % axis_size
+        if n_pad and use_flash:
+            raise ValueError(
+                f"N={n} must divide the {axis_name}={axis_size} axis for "
+                "the flash ring (masking needs the lax path)")
+        t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        qt, kt, vt = (jnp.pad(t(x), pad) for x in (q, k, v))
+        mask = (jnp.arange(n + n_pad) < n)
+        out = ring(qt, kt, vt, mask)
+        return t(out[:, :, :n, :])
+
+    return attn_fn

@@ -145,3 +145,35 @@ class TestCheckpoint:
 
         out = surgical_load(params, pretrained, resize_fn=resize)
         np.testing.assert_array_equal(out["pos"], np.ones((4,)))
+
+
+class TestRestoreVariables:
+    """One shared interpretation of inference checkpoints for every CLI
+    (predict/evaluate/demo) — EMA preferred, batch_stats merged."""
+
+    def test_trainstate_dict_prefers_ema_and_merges_stats(self, tmp_path):
+        import jax.numpy as jnp
+        from deeplearning_tpu.core.checkpoint import (restore_variables,
+                                                      save_pytree)
+        ckpt = {"params": {"w": jnp.ones(2)},
+                "ema_params": {"w": jnp.full(2, 3.0)},
+                "batch_stats": {"bn": {"mean": jnp.full(1, 7.0)}},
+                "step": 5}
+        path = str(tmp_path / "ck")
+        save_pytree(path, ckpt)
+        init = {"params": {"w": jnp.zeros(2)},
+                "batch_stats": {"bn": {"mean": jnp.zeros(1)}}}
+        v = restore_variables(path, init)
+        assert float(v["params"]["w"][0]) == 3.0
+        assert float(v["batch_stats"]["bn"]["mean"][0]) == 7.0
+        v2 = restore_variables(path, init, prefer_ema=False)
+        assert float(v2["params"]["w"][0]) == 1.0
+
+    def test_bare_param_tree(self, tmp_path):
+        import jax.numpy as jnp
+        from deeplearning_tpu.core.checkpoint import (restore_variables,
+                                                      save_pytree)
+        path = str(tmp_path / "ck")
+        save_pytree(path, {"w": jnp.full(2, 4.0)})
+        v = restore_variables(path, {"params": {"w": jnp.zeros(2)}})
+        assert float(v["params"]["w"][0]) == 4.0

@@ -49,29 +49,29 @@ def main(argv=None) -> int:
                     help="json mapping class index -> name")
     args = ap.parse_args(argv)
 
-    from deeplearning_tpu.core.checkpoint import load_pytree
+    from deeplearning_tpu.core.checkpoint import restore_variables
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.data.datasets import load_image
     from deeplearning_tpu.utils.visualize import draw_boxes
     from train_detection import build_task
 
-    model = MODELS.build(args.model, num_classes=args.num_classes)
-    raw = load_image(args.input)                       # (H, W, 3) uint8
+    # fasterrcnn heads train with class 0 = background (train_detection
+    # builds them with num_classes+1 and the postprocess shifts labels)
+    model_classes = args.num_classes + (
+        1 if args.model.startswith("fasterrcnn") else 0)
+    model = MODELS.build(args.model, num_classes=model_classes)
+    raw = np.asarray(load_image(args.input), np.float32)  # (H, W, 3)
     h0, w0 = raw.shape[:2]
-    img = jax.image.resize(jnp.asarray(raw, jnp.float32),
-                           (args.size, args.size, 3), "bilinear") / 255.0
-    images = img[None]
+    if raw.max() > 1.5:          # 0-255 file input vs pre-normalized npy
+        raw = raw / 255.0
+    images = jax.image.resize(jnp.asarray(raw),
+                              (args.size, args.size, 3), "bilinear")[None]
 
     variables = model.init(jax.random.key(0), images, train=False)
+    if args.ckpt:
+        variables = restore_variables(args.ckpt, variables)
     params = variables["params"]
     stats = variables.get("batch_stats", {})
-    if args.ckpt:
-        restored = load_pytree(args.ckpt)
-        if isinstance(restored, dict):
-            params = restored.get("params", params)
-            stats = restored.get("batch_stats", stats)
-        else:
-            params = restored
 
     if args.tta:
         if not args.model.startswith("yolox"):
@@ -83,7 +83,7 @@ def main(argv=None) -> int:
             raw_fn, im, score_thresh=args.score, max_det=100))(images)
     else:
         _, predict_fn = build_task(model, args.model, args.num_classes,
-                                   score_thresh=args.score)
+                                   score_thresh=args.score, max_det=100)
         det = jax.jit(predict_fn)(params, stats, images)
 
     keep = np.asarray(det["valid"][0])
@@ -103,9 +103,10 @@ def main(argv=None) -> int:
             "score": round(float(s), 4),
             "label": names.get(int(c), int(c))}))
 
-    annotated = draw_boxes(raw.copy(), boxes,
-                           labels=[names.get(int(c), str(int(c)))
-                                   for c in labels], scores=scores)
+    annotated = draw_boxes(
+        np.clip(raw * 255.0, 0, 255).astype(np.uint8), boxes,
+        labels=[names.get(int(c), str(int(c))) for c in labels],
+        scores=scores)
     out_path = args.out or os.path.splitext(args.input)[0] + "_det.png"
     from PIL import Image
     Image.fromarray(annotated).save(out_path)

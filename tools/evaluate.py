@@ -49,7 +49,6 @@ def main(argv=None) -> int:
     if not args.npz and not args.folder:
         ap.error("one of --npz / --folder is required")
 
-    from deeplearning_tpu.core.checkpoint import load_pytree
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
                                                      miou_from_confusion,
@@ -94,18 +93,8 @@ def main(argv=None) -> int:
     variables = model.init(jax.random.key(0),
                            jnp.asarray(sample), train=False)
     if args.ckpt:
-        restored = load_pytree(args.ckpt)
-        if isinstance(restored, dict):
-            # TrainState checkpoints carry params (+ ema_params +
-            # batch_stats); BN stats MUST come from the checkpoint, not
-            # from init, or eval runs with untrained statistics
-            params = restored.get("ema_params") or restored.get(
-                "params", restored)
-            variables = {**variables, "params": params}
-            if restored.get("batch_stats"):
-                variables["batch_stats"] = restored["batch_stats"]
-        else:
-            variables = {**variables, "params": restored}
+        from deeplearning_tpu.core.checkpoint import restore_variables
+        variables = restore_variables(args.ckpt, variables)
 
     @jax.jit
     def eval_batch(imgs, labs):

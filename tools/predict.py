@@ -58,18 +58,14 @@ def main(argv=None) -> int:
                          "view (yolov5 --augment analog)")
     args = ap.parse_args(argv)
 
-    from deeplearning_tpu.core.checkpoint import load_pytree
+    from deeplearning_tpu.core.checkpoint import restore_variables
     from deeplearning_tpu.core.registry import MODELS
 
     model = MODELS.build(args.model, num_classes=args.num_classes)
     images = jnp.asarray(load_batch(args.input, args.size))
     variables = model.init(jax.random.key(0), images[:1], train=False)
     if args.ckpt:
-        restored = load_pytree(args.ckpt)
-        # accept either a bare param tree or a full TrainState dict
-        params = restored.get("params", restored) \
-            if isinstance(restored, dict) else restored
-        variables = {**variables, "params": params}
+        variables = restore_variables(args.ckpt, variables)
     if args.tta:
         from deeplearning_tpu.ops.tta import classify_tta
         probs = np.asarray(jax.jit(lambda v, x: classify_tta(

@@ -97,7 +97,8 @@ def synthetic_boxes(n: int, size: int, num_classes: int, max_gt: int,
     return images, boxes, labels, valid
 
 
-def build_task(model, name: str, num_classes: int, score_thresh: float):
+def build_task(model, name: str, num_classes: int, score_thresh: float,
+               max_det: int = 10):
     """Family dispatch. Returns
     (loss_fn(params, stats, batch, rng) -> (total_loss, new_stats),
      predict_fn(params, stats, images) -> padded det dict).
@@ -130,7 +131,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
             hw = images.shape[1:3]
             out = apply_eval(params, stats, images)
             return retinanet_postprocess(
-                out, jnp.asarray(retinanet_anchors(hw)), hw, max_det=10,
+                out, jnp.asarray(retinanet_anchors(hw)), hw, max_det=max_det,
                 score_thresh=score_thresh)
         return loss_fn, predict_fn
 
@@ -152,7 +153,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
             hw = images.shape[1:3]
             centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
             out = apply_eval(params, stats, images)
-            return yolox_postprocess(out, centers, strides, max_det=10,
+            return yolox_postprocess(out, centers, strides, max_det=max_det,
                                      score_thresh=score_thresh)
         return loss_fn, predict_fn
 
@@ -175,7 +176,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
             grid = {k: jnp.asarray(v)
                     for k, v in yolov5_grid(hw).items()}
             out = apply_eval(params, stats, images)
-            return yolov5_postprocess(out, grid, max_det=10,
+            return yolov5_postprocess(out, grid, max_det=max_det,
                                       score_thresh=score_thresh)
         return loss_fn, predict_fn
 
@@ -198,7 +199,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
             locs, _ = fcos_locations(hw)
             out = apply_eval(params, stats, images)
             return fcos_postprocess(out, jnp.asarray(locs), hw,
-                                    max_det=10, score_thresh=score_thresh)
+                                    max_det=max_det, score_thresh=score_thresh)
         return loss_fn, predict_fn
 
     if name.startswith("fasterrcnn"):
@@ -237,7 +238,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float):
             out2 = apply_eval(params, stats, images, proposals=props)
             det = fasterrcnn_postprocess(
                 out2["roi_scores"], out2["roi_deltas"], props, hw,
-                prop_valid=pvalid, score_thresh=score_thresh, max_det=10)
+                prop_valid=pvalid, score_thresh=score_thresh, max_det=max_det)
             det["labels"] = det["labels"] - 1      # back to 0-based fg
             return det
         return loss_fn, predict_fn
