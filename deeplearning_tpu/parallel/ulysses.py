@@ -29,9 +29,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .mesh import SEQ_AXIS
 
 
-def _default_attention(q, k, v, sm_scale):
+def _default_attention(q, k, v, sm_scale, valid_len=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
+    if valid_len is not None and valid_len < k.shape[2]:
+        col = jnp.arange(k.shape[2])
+        s = jnp.where(col[None, None, None, :] < valid_len, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -39,7 +42,8 @@ def _default_attention(q, k, v, sm_scale):
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = SEQ_AXIS,
                       sm_scale: Optional[float] = None,
-                      attn_fn: Optional[Callable] = None) -> jax.Array:
+                      attn_fn: Optional[Callable] = None,
+                      valid_len: Optional[int] = None) -> jax.Array:
     """Must run inside shard_map with ``axis_name`` bound; q/k/v are the
     device-local sequence chunks (B, H, N/P, D) with H divisible by the
     axis size. ``attn_fn`` sees (B, H/P, N, D) full-sequence blocks
@@ -63,9 +67,17 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return jax.lax.all_to_all(x, axis_name, split_axis=2,
                                   concat_axis=1, tiled=True)
 
+    if attn_fn is not None and valid_len is not None \
+            and valid_len < nl * p_size:
+        raise ValueError(
+            "valid_len masking is only implemented for the default inner "
+            "attention — a custom attn_fn would silently attend padded "
+            "keys. Pad N to a multiple of the axis instead.")
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if attn_fn is None:
-        out = _default_attention(qh, kh, vh, sm_scale)
+        # the gathered sequence carries any zero-padding at its global
+        # tail, so a STATIC valid_len bound masks it exactly
+        out = _default_attention(qh, kh, vh, sm_scale, valid_len=valid_len)
     else:
         # forward sm_scale when the fn accepts it (flash_attention does)
         # so an explicit scale is never silently dropped; plain
@@ -103,3 +115,56 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
         return ulysses_attention(q, k, v, axis_name, attn_fn=attn_fn)
 
     return fn
+
+
+def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
+                         use_flash: bool = False):
+    """Ulysses as a model ``attn_fn`` — the (B, N, H, D) signature every
+    transformer in the zoo accepts (same drop-in contract as
+    ring_attention.make_ring_attn_fn). Token counts that don't divide
+    the ``seq`` axis are zero-padded; padding lands at the gathered
+    sequence's tail, so the inner attention masks it with a static
+    bound. ``use_flash=True`` runs each head block through the Pallas
+    flash kernel and requires N to divide the axis exactly."""
+    from jax import shard_map
+
+    axis_size = mesh.shape[axis_name]
+    spec = P(None, None, axis_name, None)
+
+    inner = None
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention
+        inner = flash_attention
+
+    # one shard_map per distinct token count (shared by every layer of
+    # a model — the ring adapter needs just one because its mask is an
+    # operand, Ulysses' valid_len is static per shape)
+    _fns = {}
+
+    def _fn_for(n):
+        if n not in _fns:
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=not use_flash)
+            def fn(qt, kt, vt):
+                return ulysses_attention(qt, kt, vt, axis_name,
+                                         attn_fn=inner, valid_len=n)
+            _fns[n] = fn
+        return _fns[n]
+
+    def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
+        if dropout_rate and not deterministic:
+            raise NotImplementedError(
+                "ulysses attn_fn does not support attention dropout")
+        n = q.shape[1]
+        n_pad = -n % axis_size
+        if n_pad and use_flash:
+            raise ValueError(
+                f"N={n} must divide the {axis_name}={axis_size} axis for "
+                "the flash inner attention (masking needs the lax path)")
+        t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        out = _fn_for(n)(*(jnp.pad(t(x), pad) for x in (q, k, v)))
+        return t(out[:, :, :n, :])
+
+    return attn_fn

@@ -80,3 +80,39 @@ class TestUlysses:
         qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
         with pytest.raises(ValueError, match="divide"):
             jax.jit(make_ulysses_attention(mesh))(qs, ks, vs)
+
+
+class TestUlyssesAttnFnInModel:
+    def test_vit_forward_matches_naive(self):
+        """Ulysses dropped INTO a ViT via attn_fn — N=17 (16+cls) padded
+        over a 4-device seq axis, 4 heads redistributed."""
+        from deeplearning_tpu.models.classification.vit import (
+            VisionTransformer)
+        from deeplearning_tpu.parallel.ulysses import make_ulysses_attn_fn
+        mesh = build_mesh(MeshConfig(data=-1, seq=4))
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 32, 32, 3)), jnp.float32)
+
+        def tiny(attn_fn=None):
+            return VisionTransformer(
+                img_size=32, patch_size=8, num_classes=3, embed_dim=32,
+                depth=2, num_heads=4, dtype=jnp.float32, attn_fn=attn_fn)
+
+        naive = tiny()
+        variables = naive.init(jax.random.key(0), x, train=False)
+        uly = tiny(attn_fn=make_ulysses_attn_fn(mesh))
+        want = naive.apply(variables, x, train=False)
+        got = jax.jit(lambda v, xx: uly.apply(v, xx, train=False))(
+            variables, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+        g_u = jax.jit(jax.grad(lambda v: jnp.sum(
+            uly.apply(v, x, train=False).astype(jnp.float32) ** 2)))(
+            variables)
+        g_n = jax.grad(lambda v: jnp.sum(
+            naive.apply(v, x, train=False).astype(jnp.float32) ** 2))(
+            variables)
+        for a, b in zip(jax.tree.leaves(g_u), jax.tree.leaves(g_n)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)

@@ -60,10 +60,11 @@ def main(argv=None) -> int:
     model_classes = args.num_classes + (
         1 if args.model.startswith("fasterrcnn") else 0)
     model = MODELS.build(args.model, num_classes=model_classes)
+    is_npy = args.input.lower().endswith(".npy")
     raw = np.asarray(load_image(args.input), np.float32)  # (H, W, 3)
     h0, w0 = raw.shape[:2]
-    if raw.max() > 1.5:          # 0-255 file input vs pre-normalized npy
-        raw = raw / 255.0
+    if not is_npy:               # image files decode to 0-255
+        raw = raw / 255.0        # .npy is model-ready by convention
     images = jax.image.resize(jnp.asarray(raw),
                               (args.size, args.size, 3), "bilinear")[None]
 
@@ -103,8 +104,12 @@ def main(argv=None) -> int:
             "score": round(float(s), 4),
             "label": names.get(int(c), int(c))}))
 
+    # render: image files are 0-1 here; arbitrary-range .npy is min-max
+    # normalized for display only
+    disp = raw if not is_npy else \
+        (raw - raw.min()) / max(raw.max() - raw.min(), 1e-6)
     annotated = draw_boxes(
-        np.clip(raw * 255.0, 0, 255).astype(np.uint8), boxes,
+        np.clip(disp * 255.0, 0, 255).astype(np.uint8), boxes,
         labels=[names.get(int(c), str(int(c))) for c in labels],
         scores=scores)
     out_path = args.out or os.path.splitext(args.input)[0] + "_det.png"
