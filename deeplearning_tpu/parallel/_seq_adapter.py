@@ -1,0 +1,48 @@
+"""Shared plumbing for sequence-parallel model ``attn_fn`` adapters.
+
+Both SP flavors (ring, Ulysses) expose the zoo's (B, N, H, D) attention
+signature through the same adapter: transpose to (B, H, N, D), zero-pad
+the token dim to a multiple of the ``seq`` axis, run the shard_mapped
+attention, slice and transpose back. One copy here so the contract
+(dropout guard, flash divisibility rule, padding policy, batch-dim
+sharding) cannot diverge between the two.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def batch_axis(mesh: Mesh) -> Optional[str]:
+    """The mesh axis the batch dim shards over inside the adapters'
+    shard_maps — without it the activations would be replicated across
+    ``data`` and every layer would all-gather the global batch."""
+    return "data" if "data" in mesh.axis_names else None
+
+
+def seq_attn_adapter(axis_size: int, flavor: str, use_flash: bool,
+                     sharded_call: Callable) -> Callable:
+    """Wrap ``sharded_call(qt, kt, vt, n_valid) -> (B, H, Npad, D)``
+    into the models' attn_fn signature. ``axis_size`` is the seq-axis
+    extent; the batch dim must divide the mesh's data axis (training
+    batches do; build an inference mesh with data=1 otherwise)."""
+
+    def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
+        if dropout_rate and not deterministic:
+            raise NotImplementedError(
+                f"{flavor} attn_fn does not support attention dropout")
+        n = q.shape[1]
+        n_pad = -n % axis_size
+        if n_pad and use_flash:
+            raise ValueError(
+                f"N={n} must divide the seq axis ({axis_size}) for the "
+                f"flash {flavor} path (masking needs the lax path)")
+        t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
+        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
+        out = sharded_call(*(jnp.pad(t(x), pad) for x in (q, k, v)), n)
+        return t(out[:, :, :n, :])
+
+    return attn_fn

@@ -95,13 +95,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out
 
 
-def _pvary(tree, axis_name):
-    """Mark zero accumulators as device-varying over the ring axis so
-    fori_loop carry types match the loop body's output types."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.tree.map(
-            lambda x: jax.lax.pcast(x, axis_name, to="varying"), tree)
-    return jax.tree.map(lambda x: jax.lax.pvary(x, (axis_name,)), tree)
+def _zero_like_varying(x, fill=0.0, drop_last=False):
+    """A fill-valued f32 array DERIVED from ``x`` so it carries exactly
+    x's varying-mesh-axes type — fori_loop requires carry init and body
+    output types to match, and the body's accumulators inherit the
+    inputs' axes (seq, and data when the batch dim is sharded)."""
+    z = x.astype(jnp.float32)
+    if drop_last:
+        z = z[..., 0]
+    return z * 0.0 + fill
 
 
 def _ring_forward(q, k, v, axis_name, sm_scale, use_flash,
@@ -130,10 +132,9 @@ def _ring_forward(q, k, v, axis_name, sm_scale, use_flash,
             mm = jax.lax.ppermute(mm, axis_name, perm)
         return carry, kk, vv, mm
 
-    b, h, nq, d = q.shape
-    init = _pvary((jnp.zeros((b, h, nq, d), jnp.float32),
-                   jnp.full((b, h, nq), -jnp.inf, jnp.float32),
-                   jnp.zeros((b, h, nq), jnp.float32)), axis_name)
+    init = (_zero_like_varying(q),
+            _zero_like_varying(q, fill=-jnp.inf, drop_last=True),
+            _zero_like_varying(q, drop_last=True))
     (num, m, l), _, _, _ = jax.lax.fori_loop(
         0, axis_size, body, (init, k, v, kv_mask))
     l_safe = jnp.maximum(l, 1e-30)
@@ -177,11 +178,10 @@ def _ring_flash_bwd(axis_name, sm_scale, res, dout):
                             for t in (kk, vv, dkk, dvv))
         return dq, kk, vv, dkk, dvv
 
-    zeros = _pvary((jnp.zeros(q.shape, jnp.float32),
-                    jnp.zeros(k.shape, jnp.float32),
-                    jnp.zeros(v.shape, jnp.float32)), axis_name)
     dq, _, _, dk, dv = jax.lax.fori_loop(
-        0, axis_size, body, (zeros[0], k, v, zeros[1], zeros[2]))
+        0, axis_size, body,
+        (_zero_like_varying(q), k, v,
+         _zero_like_varying(k), _zero_like_varying(v)))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -216,7 +216,8 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     mae). This is how sequence parallelism drops INTO a model instead of
     living beside it: build any ViT with
     ``attn_fn=make_ring_attn_fn(mesh)`` and its attention shards over
-    the ``seq`` axis while the rest of the model stays GSPMD-sharded.
+    the ``seq`` axis while the rest of the model stays GSPMD-sharded
+    (batch over ``data``, sequence over ``seq``).
 
     Token counts rarely divide the seq axis (ViT-B/16 has 197 = 196+cls),
     so inputs are zero-padded to a multiple and a KV validity mask rides
@@ -224,32 +225,32 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     unpadded length to divide the axis exactly."""
     from jax import shard_map
 
+    from ._seq_adapter import batch_axis, seq_attn_adapter
+
     axis_size = mesh.shape[axis_name]
-    spec = P(None, None, axis_name, None)
+    b_axis = batch_axis(mesh)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(spec, spec, spec, P(axis_name)),
-        out_specs=spec, check_vma=not use_flash)
-    def ring(q, k, v, mask):
-        return ring_attention(q, k, v, axis_name, use_flash=use_flash,
-                              kv_mask=None if use_flash else mask)
+    def _make(shard_batch):
+        spec = P(b_axis if shard_batch else None, None, axis_name, None)
 
-    def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
-        if dropout_rate and not deterministic:
-            raise NotImplementedError(
-                "ring attn_fn does not support attention dropout")
-        n = q.shape[1]
-        n_pad = -n % axis_size
-        if n_pad and use_flash:
-            raise ValueError(
-                f"N={n} must divide the {axis_name}={axis_size} axis for "
-                "the flash ring (masking needs the lax path)")
-        t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
-        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        qt, kt, vt = (jnp.pad(t(x), pad) for x in (q, k, v))
-        mask = (jnp.arange(n + n_pad) < n)
-        out = ring(qt, kt, vt, mask)
-        return t(out[:, :, :n, :])
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(spec, spec, spec, P(axis_name)),
+            out_specs=spec, check_vma=not use_flash)
+        def ring(q, k, v, mask):
+            return ring_attention(q, k, v, axis_name, use_flash=use_flash,
+                                  kv_mask=None if use_flash else mask)
+        return ring
 
-    return attn_fn
+    rings = {True: _make(True), False: _make(False)} if b_axis \
+        else {True: _make(False), False: _make(False)}
+
+    def call(qt, kt, vt, n):
+        # shard the batch over 'data' when it divides (training); fall
+        # back to a replicated batch for small/odd batches (model.init
+        # traces with batch 1)
+        sharded = bool(b_axis) and qt.shape[0] % mesh.shape[b_axis] == 0
+        mask = jnp.arange(qt.shape[2]) < n
+        return rings[sharded](qt, kt, vt, mask)
+
+    return seq_attn_adapter(axis_size, "ring", use_flash, call)

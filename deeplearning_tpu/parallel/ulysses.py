@@ -128,43 +128,35 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     flash kernel and requires N to divide the axis exactly."""
     from jax import shard_map
 
+    from ._seq_adapter import batch_axis, seq_attn_adapter
+
     axis_size = mesh.shape[axis_name]
-    spec = P(None, None, axis_name, None)
+    b_axis = batch_axis(mesh)
 
     inner = None
     if use_flash:
         from ..ops.pallas.flash_attention import flash_attention
         inner = flash_attention
 
-    # one shard_map per distinct token count (shared by every layer of
-    # a model — the ring adapter needs just one because its mask is an
-    # operand, Ulysses' valid_len is static per shape)
+    # one shard_map per (token count, batch-sharded?) — shared by every
+    # layer of a model; Ulysses' valid_len is static per shape
     _fns = {}
 
-    def _fn_for(n):
-        if n not in _fns:
+    def call(qt, kt, vt, n):
+        # batch shards over 'data' when it divides (training); falls
+        # back to replicated for model.init's batch-1 trace
+        sharded = bool(b_axis) and qt.shape[0] % mesh.shape[b_axis] == 0
+        key = (n, sharded)
+        if key not in _fns:
+            spec = P(b_axis if sharded else None, None, axis_name, None)
+
             @functools.partial(
                 shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_vma=not use_flash)
-            def fn(qt, kt, vt):
-                return ulysses_attention(qt, kt, vt, axis_name,
+            def fn(q, k, v):
+                return ulysses_attention(q, k, v, axis_name,
                                          attn_fn=inner, valid_len=n)
-            _fns[n] = fn
-        return _fns[n]
+            _fns[key] = fn
+        return _fns[key](qt, kt, vt)
 
-    def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
-        if dropout_rate and not deterministic:
-            raise NotImplementedError(
-                "ulysses attn_fn does not support attention dropout")
-        n = q.shape[1]
-        n_pad = -n % axis_size
-        if n_pad and use_flash:
-            raise ValueError(
-                f"N={n} must divide the {axis_name}={axis_size} axis for "
-                "the flash inner attention (masking needs the lax path)")
-        t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
-        pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        out = _fn_for(n)(*(jnp.pad(t(x), pad) for x in (q, k, v)))
-        return t(out[:, :, :n, :])
-
-    return attn_fn
+    return seq_attn_adapter(axis_size, "ulysses", use_flash, call)
