@@ -1,0 +1,60 @@
+"""One-line model loading (yolov5 ``hubconf.py`` surface).
+
+The reference exposes ``torch.hub.load('ultralytics/yolov5', 'yolov5s')``
+returning a ready-to-run model. The TPU-native equivalent returns the
+flax module plus initialized (optionally checkpoint-restored) variables
+and a jitted forward:
+
+    from deeplearning_tpu import hub
+    model, variables, forward = hub.load(
+        "yolox_s", num_classes=80, ckpt="runs/x/ckpt/best",
+        input_shape=(1, 640, 640, 3))
+    out = forward(images)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["load", "list_models"]
+
+
+def list_models(filter: str = "") -> list:
+    """Registry names, optionally substring-filtered (timm list_models
+    idiom)."""
+    from .core.registry import MODELS
+    names = sorted(MODELS.keys())
+    return [n for n in names if filter in n] if filter else names
+
+
+def load(name: str, *, num_classes: int = 1000,
+         ckpt: Optional[str] = None,
+         input_shape: Tuple[int, ...] = (1, 224, 224, 3),
+         seed: int = 0, prefer_ema: bool = True,
+         **model_kw) -> Tuple[Any, Dict, Callable]:
+    """Build a registry model, init its variables on ``input_shape``,
+    optionally restore a checkpoint (EMA-preferring, shared
+    ``restore_variables`` semantics), and return
+    ``(module, variables, forward)`` where ``forward(x)`` is the jitted
+    ``train=False`` apply. Detection models return raw head outputs —
+    postprocess with their family's ``*_postprocess`` (tools/demo.py
+    shows the full pipeline)."""
+    from .core.registry import MODELS
+
+    model = MODELS.build(name, num_classes=num_classes, **model_kw)
+    variables = model.init(jax.random.key(seed),
+                           jnp.zeros(input_shape, jnp.float32),
+                           train=False)
+    if ckpt:
+        from .core.checkpoint import restore_variables
+        variables = restore_variables(ckpt, variables,
+                                      prefer_ema=prefer_ema)
+
+    @jax.jit
+    def forward(x, variables=variables):
+        return model.apply(variables, x, train=False)
+
+    return model, variables, forward

@@ -10,24 +10,34 @@ sharding) cannot diverge between the two.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def batch_axis(mesh: Mesh) -> Optional[str]:
-    """The mesh axis the batch dim shards over inside the adapters'
-    shard_maps — without it the activations would be replicated across
-    ``data`` and every layer would all-gather the global batch."""
-    return "data" if "data" in mesh.axis_names else None
+def batch_axes(mesh: Mesh) -> Optional[Tuple[str, ...]]:
+    """The mesh axes the batch dim shards over inside the adapters'
+    shard_maps — the SAME set sharding.batch_spec uses (('data',
+    'fsdp') when present). Without them the activations would be
+    replicated across those axes and every layer would all-gather the
+    global batch."""
+    axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    return axes or None
 
 
-def seq_attn_adapter(axis_size: int, flavor: str, use_flash: bool,
-                     sharded_call: Callable) -> Callable:
+def batch_extent(mesh: Mesh, axes: Optional[Tuple[str, ...]]) -> int:
+    ext = 1
+    for a in axes or ():
+        ext *= mesh.shape[a]
+    return ext
+
+
+def seq_attn_adapter(axis_size: int, axis_name: str, flavor: str,
+                     use_flash: bool, sharded_call: Callable) -> Callable:
     """Wrap ``sharded_call(qt, kt, vt, n_valid) -> (B, H, Npad, D)``
     into the models' attn_fn signature. ``axis_size`` is the seq-axis
-    extent; the batch dim must divide the mesh's data axis (training
+    extent; the batch dim must divide the mesh's batch axes (training
     batches do; build an inference mesh with data=1 otherwise)."""
 
     def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
@@ -38,8 +48,9 @@ def seq_attn_adapter(axis_size: int, flavor: str, use_flash: bool,
         n_pad = -n % axis_size
         if n_pad and use_flash:
             raise ValueError(
-                f"N={n} must divide the seq axis ({axis_size}) for the "
-                f"flash {flavor} path (masking needs the lax path)")
+                f"N={n} must divide the {axis_name}={axis_size} axis "
+                f"for the flash {flavor} path (masking needs the lax "
+                "path)")
         t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
         pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
         out = sharded_call(*(jnp.pad(t(x), pad) for x in (q, k, v)), n)

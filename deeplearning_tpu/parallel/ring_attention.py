@@ -225,32 +225,37 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     unpadded length to divide the axis exactly."""
     from jax import shard_map
 
-    from ._seq_adapter import batch_axis, seq_attn_adapter
+    from ._seq_adapter import batch_axes, batch_extent, seq_attn_adapter
 
     axis_size = mesh.shape[axis_name]
-    b_axis = batch_axis(mesh)
+    b_axes = batch_axes(mesh)
+    b_ext = batch_extent(mesh, b_axes)
 
-    def _make(shard_batch):
-        spec = P(b_axis if shard_batch else None, None, axis_name, None)
+    rings = {}
 
-        @functools.partial(
-            shard_map, mesh=mesh,
-            in_specs=(spec, spec, spec, P(axis_name)),
-            out_specs=spec, check_vma=not use_flash)
-        def ring(q, k, v, mask):
-            return ring_attention(q, k, v, axis_name, use_flash=use_flash,
-                                  kv_mask=None if use_flash else mask)
-        return ring
+    def _ring_for(shard_batch):
+        if shard_batch not in rings:
+            spec = P(b_axes if shard_batch else None, None, axis_name,
+                     None)
 
-    rings = {True: _make(True), False: _make(False)} if b_axis \
-        else {True: _make(False), False: _make(False)}
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(spec, spec, spec, P(axis_name)),
+                out_specs=spec, check_vma=not use_flash)
+            def ring(q, k, v, mask):
+                return ring_attention(
+                    q, k, v, axis_name, use_flash=use_flash,
+                    kv_mask=None if use_flash else mask)
+            rings[shard_batch] = ring
+        return rings[shard_batch]
 
     def call(qt, kt, vt, n):
-        # shard the batch over 'data' when it divides (training); fall
-        # back to a replicated batch for small/odd batches (model.init
-        # traces with batch 1)
-        sharded = bool(b_axis) and qt.shape[0] % mesh.shape[b_axis] == 0
+        # shard the batch over the mesh's batch axes (data/fsdp) when it
+        # divides (training); fall back to a replicated batch for
+        # small/odd batches (model.init traces with batch 1)
+        sharded = b_ext > 1 and qt.shape[0] % b_ext == 0
         mask = jnp.arange(qt.shape[2]) < n
-        return rings[sharded](qt, kt, vt, mask)
+        return _ring_for(sharded)(qt, kt, vt, mask)
 
-    return seq_attn_adapter(axis_size, "ring", use_flash, call)
+    return seq_attn_adapter(axis_size, axis_name, "ring", use_flash,
+                            call)

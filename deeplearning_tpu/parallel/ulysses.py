@@ -128,10 +128,11 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     flash kernel and requires N to divide the axis exactly."""
     from jax import shard_map
 
-    from ._seq_adapter import batch_axis, seq_attn_adapter
+    from ._seq_adapter import batch_axes, batch_extent, seq_attn_adapter
 
     axis_size = mesh.shape[axis_name]
-    b_axis = batch_axis(mesh)
+    b_axes = batch_axes(mesh)
+    b_ext = batch_extent(mesh, b_axes)
 
     inner = None
     if use_flash:
@@ -143,12 +144,13 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     _fns = {}
 
     def call(qt, kt, vt, n):
-        # batch shards over 'data' when it divides (training); falls
-        # back to replicated for model.init's batch-1 trace
-        sharded = bool(b_axis) and qt.shape[0] % mesh.shape[b_axis] == 0
+        # batch shards over the mesh's batch axes (data/fsdp) when it
+        # divides (training); replicated fallback covers model.init's
+        # batch-1 trace
+        sharded = b_ext > 1 and qt.shape[0] % b_ext == 0
         key = (n, sharded)
         if key not in _fns:
-            spec = P(b_axis if sharded else None, None, axis_name, None)
+            spec = P(b_axes if sharded else None, None, axis_name, None)
 
             @functools.partial(
                 shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -159,4 +161,5 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
             _fns[key] = fn
         return _fns[key](qt, kt, vt)
 
-    return seq_attn_adapter(axis_size, "ulysses", use_flash, call)
+    return seq_attn_adapter(axis_size, axis_name, "ulysses", use_flash,
+                            call)

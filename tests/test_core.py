@@ -177,3 +177,31 @@ class TestRestoreVariables:
         save_pytree(path, {"w": jnp.full(2, 4.0)})
         v = restore_variables(path, {"params": {"w": jnp.zeros(2)}})
         assert float(v["params"]["w"][0]) == 4.0
+
+
+class TestHub:
+    def test_load_and_forward(self, tmp_path):
+        import jax.numpy as jnp
+        from deeplearning_tpu import hub
+        assert "resnet18" in hub.list_models("resnet")
+        model, variables, forward = hub.load(
+            "mnist_cnn", num_classes=4, input_shape=(1, 28, 28, 1))
+        out = forward(jnp.zeros((2, 28, 28, 1)))
+        assert out.shape == (2, 4)
+
+    def test_load_with_ckpt(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+        from deeplearning_tpu import hub
+        from deeplearning_tpu.core.checkpoint import save_pytree
+        _, variables, _ = hub.load("mnist_cnn", num_classes=4,
+                                   input_shape=(1, 28, 28, 1))
+        mutated = {"params": jax.tree.map(lambda x: x + 1.0,
+                                          variables["params"])}
+        path = str(tmp_path / "ck")
+        save_pytree(path, mutated)
+        _, v2, fwd = hub.load("mnist_cnn", num_classes=4,
+                              input_shape=(1, 28, 28, 1), ckpt=path)
+        a = jax.tree.leaves(v2["params"])[0]
+        b = jax.tree.leaves(variables["params"])[0]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b) + 1.0)

@@ -65,10 +65,13 @@ def main(argv=None) -> int:
     h0, w0 = raw.shape[:2]
     if not is_npy:               # image files decode to 0-255
         raw = raw / 255.0        # .npy is model-ready by convention
-    elif raw.max() > 1.5:
-        print(f"warning: .npy input has max {raw.max():.1f} — arrays "
-              "must be model-ready (normalized); raw 0-255 pixel .npy "
-              "will produce garbage detections", file=sys.stderr)
+    elif raw.max() > 4.0:
+        # mean/std-normalized arrays top out near ~3; values beyond
+        # that mean raw 0-255 pixels were saved un-normalized
+        print(f"warning: .npy input has max {raw.max():.1f} — looks "
+              "like raw 0-255 pixels; .npy must be model-ready "
+              "(normalized) or detections will be garbage",
+              file=sys.stderr)
     images = jax.image.resize(jnp.asarray(raw),
                               (args.size, args.size, 3), "bilinear")[None]
 

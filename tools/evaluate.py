@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--out-json", default=None)
+    ap.add_argument("--tta", action="store_true",
+                    help="average probabilities over a horizontal flip "
+                         "(yolov5 val --augment analog)")
     args = ap.parse_args(argv)
     if not args.npz and not args.folder:
         ap.error("one of --npz / --folder is required")
@@ -98,9 +101,15 @@ def main(argv=None) -> int:
 
     @jax.jit
     def eval_batch(imgs, labs):
-        logits = model.apply(variables, imgs, train=False)
-        counts = topk_correct(logits, labs)
-        cm = confusion_matrix(jnp.argmax(logits, -1), labs,
+        if args.tta:
+            from deeplearning_tpu.ops.tta import classify_tta
+            probs = classify_tta(
+                lambda x: model.apply(variables, x, train=False), imgs)
+            scores = jnp.log(jnp.maximum(probs, 1e-30))  # rank-equivalent
+        else:
+            scores = model.apply(variables, imgs, train=False)
+        counts = topk_correct(scores, labs)
+        cm = confusion_matrix(jnp.argmax(scores, -1), labs,
                               args.num_classes)
         return counts, cm
 
