@@ -48,9 +48,9 @@ def seq_attn_adapter(axis_size: int, axis_name: str, flavor: str,
         n_pad = -n % axis_size
         if n_pad and use_flash:
             raise ValueError(
-                f"N={n} must divide the {axis_name}={axis_size} axis "
-                f"for the flash {flavor} path (masking needs the lax "
-                "path)")
+                f"the {axis_name} axis size ({axis_size}) must divide "
+                f"N={n} for the flash {flavor} path (masking needs the "
+                "lax path)")
         t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
         pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
         out = sharded_call(*(jnp.pad(t(x), pad) for x in (q, k, v)), n)

@@ -1,0 +1,33 @@
+"""Sequence parallelism as a USER-FACING training option:
+train.mesh_seq_axis + train.seq_parallel build the ring/Ulysses attn_fn
+into the model through tools/train.py (long-context training is
+first-class, not a library-only capability)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+COMMON = ["model.name=vit_base_patch16_224", "model.num_classes=4",
+          "data.synthetic=true", "data.image_size=32", "data.channels=3",
+          "data.n_train=16", "data.global_batch=8", "train.epochs=1"]
+
+
+@pytest.mark.parametrize("flavor", ["ring", "ulysses"])
+def test_sp_training_through_cli(flavor, capsys):
+    from train import main
+    rc = main(COMMON + ["train.mesh_seq_axis=2",
+                        f"train.seq_parallel={flavor}"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "loss_sum" in out
+
+
+def test_unknown_flavor_rejected():
+    from train import main
+    with pytest.raises(ValueError, match="seq_parallel"):
+        main(COMMON + ["train.mesh_seq_axis=2",
+                       "train.seq_parallel=nope"])

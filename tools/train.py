@@ -73,6 +73,8 @@ class TrainCfg:
     ema: bool = False
     workdir: Optional[str] = None
     mesh_model_axis: int = 1         # >1 enables tensor parallelism
+    mesh_seq_axis: int = 1           # >1 enables sequence parallelism
+    seq_parallel: str = "ring"       # ring | ulysses (transformers only)
     accum_steps: int = 1             # gradient accumulation microbatches
     mixup: bool = False              # mixup/cutmix soft targets
 
@@ -114,7 +116,8 @@ def main(argv=None) -> int:
     from deeplearning_tpu.train.trainer import Trainer
 
     cfg = config_cli(Config(), argv, description=__doc__)
-    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis))
+    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis,
+                                 seq=cfg.train.mesh_seq_axis))
     if cfg.data.folder:
         from deeplearning_tpu.data.build import (LoaderConfig,
                                                  build_classification_loaders)
@@ -140,8 +143,26 @@ def main(argv=None) -> int:
         sample_shape = (1,) + images.shape[1:]
         n_train = len(images)
     dtype = jnp.bfloat16 if cfg.model.precision == "bf16" else jnp.float32
+    model_kw = {}
+    if cfg.train.seq_parallel not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown train.seq_parallel={cfg.train.seq_parallel!r} "
+            "(ring | ulysses)")
+    if cfg.train.mesh_seq_axis > 1:
+        # sequence parallelism INSIDE the model: every attention layer
+        # shards its tokens over the 'seq' mesh axis (ring rotation or
+        # Ulysses all-to-all) while batch/params stay GSPMD-sharded.
+        # Transformers only — the builder must accept attn_fn.
+        if cfg.train.seq_parallel == "ring":
+            from deeplearning_tpu.parallel.ring_attention import (
+                make_ring_attn_fn)
+            model_kw["attn_fn"] = make_ring_attn_fn(mesh)
+        else:
+            from deeplearning_tpu.parallel.ulysses import (
+                make_ulysses_attn_fn)
+            model_kw["attn_fn"] = make_ulysses_attn_fn(mesh)
     model = MODELS.build(cfg.model.name, num_classes=cfg.model.num_classes,
-                         dtype=dtype)
+                         dtype=dtype, **model_kw)
     sample = jnp.zeros(sample_shape)
     variables = model.init(jax.random.key(cfg.train.seed), sample,
                            train=False)
