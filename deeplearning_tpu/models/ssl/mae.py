@@ -13,7 +13,7 @@ sees kept tokens + learned mask tokens unshuffled back into place.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -70,6 +70,7 @@ class MAE(nn.Module):
     mask_ratio: float = 0.75
     norm_pix_loss: bool = True
     dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None   # e.g. make_ring_attn_fn(mesh)
 
     @nn.compact
     def __call__(self, imgs: jax.Array, train: bool = False,
@@ -94,6 +95,7 @@ class MAE(nn.Module):
         kept, mask, restore = random_masking(x, self.mask_ratio, rng)
         for i in range(self.depth):
             kept = Block(self.num_heads, dtype=self.dtype,
+                         attn_fn=self.attn_fn,
                          name=f"enc_block{i}")(kept, deterministic=not train)
         kept = nn.LayerNorm(dtype=self.dtype, name="enc_norm")(kept)
 
@@ -113,6 +115,7 @@ class MAE(nn.Module):
         full = full + dec_pos.astype(full.dtype)
         for i in range(self.decoder_depth):
             full = Block(self.decoder_heads, dtype=self.dtype,
+                         attn_fn=self.attn_fn,
                          name=f"dec_block{i}")(full,
                                                deterministic=not train)
         full = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(full)

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def batch_axes(mesh: Mesh) -> Optional[Tuple[str, ...]]:
@@ -33,12 +34,37 @@ def batch_extent(mesh: Mesh, axes: Optional[Tuple[str, ...]]) -> int:
     return ext
 
 
-def seq_attn_adapter(axis_size: int, axis_name: str, flavor: str,
-                     use_flash: bool, sharded_call: Callable) -> Callable:
+def seq_attn_adapter(mesh: Mesh, axis_size: int, axis_name: str,
+                     flavor: str, use_flash: bool,
+                     sharded_call: Callable) -> Callable:
     """Wrap ``sharded_call(qt, kt, vt, n_valid) -> (B, H, Npad, D)``
     into the models' attn_fn signature. ``axis_size`` is the seq-axis
-    extent; the batch dim must divide the mesh's batch axes (training
-    batches do; build an inference mesh with data=1 otherwise)."""
+    extent. The batch dim shards over the mesh's batch axes when it
+    divides them (training batches do); otherwise it stays replicated —
+    the ``sharded`` flag passed to ``sharded_call`` says which, so the
+    flavor's shard_map spec always matches the boundary pin.
+
+    The adapter PINS its boundary sharding to batch-axes-only (sequence
+    replicated outside the shard_map): letting the N-over-seq sharding
+    propagate into the surrounding graph reaches the patch-embed
+    convolution through token reshapes, and GSPMD's spatially
+    partitioned conv path miscompiles on the virtual-CPU backend
+    (observed: patch_embed off by O(1) with identical inputs/params).
+    The O(N²) attention itself still splits over ``seq`` inside the
+    shard_map — that is the part sequence parallelism exists for; the
+    elementwise inter-layer stream stays batch-sharded."""
+    b_spec = NamedSharding(
+        mesh, P(batch_axes(mesh), None, None, None))
+    b_ext = batch_extent(mesh, batch_axes(mesh))
+
+    def shardable(b):
+        return b_ext > 1 and b % b_ext == 0
+
+    def pin(x):
+        if shardable(x.shape[0]):
+            return jax.lax.with_sharding_constraint(x, b_spec)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, None, None, None)))
 
     def attn_fn(q, k, v, dropout_rate=0.0, deterministic=True, rng=None):
         if dropout_rate and not deterministic:
@@ -53,7 +79,9 @@ def seq_attn_adapter(axis_size: int, axis_name: str, flavor: str,
                 "lax path)")
         t = lambda x: x.transpose(0, 2, 1, 3)     # -> (B, H, N, D)
         pad = [(0, 0), (0, 0), (0, n_pad), (0, 0)]
-        out = sharded_call(*(jnp.pad(t(x), pad) for x in (q, k, v)), n)
-        return t(out[:, :, :n, :])
+        out = sharded_call(*(pin(jnp.pad(t(x), pad))
+                             for x in (q, k, v)), n,
+                           shardable(q.shape[0]))
+        return t(pin(out)[:, :, :n, :])
 
     return attn_fn

@@ -225,11 +225,10 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     unpadded length to divide the axis exactly."""
     from jax import shard_map
 
-    from ._seq_adapter import batch_axes, batch_extent, seq_attn_adapter
+    from ._seq_adapter import batch_axes, seq_attn_adapter
 
     axis_size = mesh.shape[axis_name]
     b_axes = batch_axes(mesh)
-    b_ext = batch_extent(mesh, b_axes)
 
     rings = {}
 
@@ -249,13 +248,9 @@ def make_ring_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
             rings[shard_batch] = ring
         return rings[shard_batch]
 
-    def call(qt, kt, vt, n):
-        # shard the batch over the mesh's batch axes (data/fsdp) when it
-        # divides (training); fall back to a replicated batch for
-        # small/odd batches (model.init traces with batch 1)
-        sharded = b_ext > 1 and qt.shape[0] % b_ext == 0
+    def call(qt, kt, vt, n, sharded):
         mask = jnp.arange(qt.shape[2]) < n
         return _ring_for(sharded)(qt, kt, vt, mask)
 
-    return seq_attn_adapter(axis_size, axis_name, "ring", use_flash,
-                            call)
+    return seq_attn_adapter(mesh, axis_size, axis_name, "ring",
+                            use_flash, call)

@@ -128,11 +128,10 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     flash kernel and requires N to divide the axis exactly."""
     from jax import shard_map
 
-    from ._seq_adapter import batch_axes, batch_extent, seq_attn_adapter
+    from ._seq_adapter import batch_axes, seq_attn_adapter
 
     axis_size = mesh.shape[axis_name]
     b_axes = batch_axes(mesh)
-    b_ext = batch_extent(mesh, b_axes)
 
     inner = None
     if use_flash:
@@ -143,11 +142,7 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
     # layer of a model; Ulysses' valid_len is static per shape
     _fns = {}
 
-    def call(qt, kt, vt, n):
-        # batch shards over the mesh's batch axes (data/fsdp) when it
-        # divides (training); replicated fallback covers model.init's
-        # batch-1 trace
-        sharded = b_ext > 1 and qt.shape[0] % b_ext == 0
+    def call(qt, kt, vt, n, sharded):
         key = (n, sharded)
         if key not in _fns:
             spec = P(b_axes if sharded else None, None, axis_name, None)
@@ -161,5 +156,5 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
             _fns[key] = fn
         return _fns[key](qt, kt, vt)
 
-    return seq_attn_adapter(axis_size, axis_name, "ulysses", use_flash,
-                            call)
+    return seq_attn_adapter(mesh, axis_size, axis_name, "ulysses",
+                            use_flash, call)

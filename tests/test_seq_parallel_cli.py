@@ -31,3 +31,30 @@ def test_unknown_flavor_rejected():
     with pytest.raises(ValueError, match="seq_parallel"):
         main(COMMON + ["train.mesh_seq_axis=2",
                        "train.seq_parallel=nope"])
+
+
+def test_mae_with_ring_attn_matches_plain():
+    """MAE pretraining composes with SP: same loss with and without the
+    ring attn_fn (the ring is exact attention)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.parallel import MeshConfig, build_mesh
+    from deeplearning_tpu.parallel.ring_attention import make_ring_attn_fn
+
+    mesh = build_mesh(MeshConfig(data=-1, seq=2))
+    imgs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 32, 32, 3)), jnp.float32)
+    rng = jax.random.key(1)
+    plain = MODELS.build("mae_vit_small_patch16", patch_size=8,
+                         dtype=jnp.float32)
+    variables = plain.init(jax.random.key(0), imgs, train=False, rng=rng)
+    ringed = MODELS.build("mae_vit_small_patch16", patch_size=8,
+                          dtype=jnp.float32,
+                          attn_fn=make_ring_attn_fn(mesh))
+    loss_p, _, _ = plain.apply(variables, imgs, train=False, rng=rng)
+    loss_r, _, _ = jax.jit(
+        lambda v, x: ringed.apply(v, x, train=False, rng=rng))(
+        variables, imgs)
+    np.testing.assert_allclose(float(loss_r), float(loss_p), rtol=1e-4)
