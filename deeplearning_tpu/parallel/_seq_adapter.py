@@ -37,8 +37,8 @@ def batch_extent(mesh: Mesh, axes: Optional[Tuple[str, ...]]) -> int:
 def seq_attn_adapter(mesh: Mesh, axis_size: int, axis_name: str,
                      flavor: str, use_flash: bool,
                      sharded_call: Callable) -> Callable:
-    """Wrap ``sharded_call(qt, kt, vt, n_valid) -> (B, H, Npad, D)``
-    into the models' attn_fn signature. ``axis_size`` is the seq-axis
+    """Wrap ``sharded_call(qt, kt, vt, n_valid, sharded) ->
+    (B, H, Npad, D)`` into the models' attn_fn signature. ``axis_size`` is the seq-axis
     extent. The batch dim shards over the mesh's batch axes when it
     divides them (training batches do); otherwise it stays replicated —
     the ``sharded`` flag passed to ``sharded_call`` says which, so the
