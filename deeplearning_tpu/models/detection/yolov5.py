@@ -245,6 +245,27 @@ def kmean_anchors(wh: np.ndarray, n: int = 9,
     return centers[np.argsort(centers.prod(1))]
 
 
+def check_anchors(wh: np.ndarray, anchors: np.ndarray, thr: float = 4.0
+                  ) -> dict:
+    """Best-possible-recall anchor fit check (autoanchor.py:39
+    check_anchors metric): for each gt wh, the best anchor's worst-side
+    ratio must be within ``thr``. Returns {bpr, aat}: BPR = fraction of
+    gts some anchor can match; AAT = anchors above threshold per gt.
+    The reference recomputes anchors when BPR < 0.98."""
+    wh = np.asarray(wh, np.float64)
+    wh = wh[(wh > 0).all(1)]
+    if len(wh) == 0:
+        raise ValueError(
+            "check_anchors: no valid gt boxes (all empty or non-positive "
+            "wh) — a nan BPR would silently pass the < 0.98 gate")
+    anchors = np.asarray(anchors, np.float64).reshape(-1, 2)
+    r = wh[:, None] / anchors[None]                    # (G, A, 2)
+    x = np.minimum(r, 1.0 / r).min(2)                  # worst side
+    best = x.max(1)
+    return {"bpr": float((best > 1.0 / thr).mean()),
+            "aat": float((x > 1.0 / thr).sum(1).mean())}
+
+
 _VARIANTS = {"yolov5s": (0.33, 0.5), "yolov5m": (0.67, 0.75),
              "yolov5l": (1.0, 1.0), "yolov5x": (1.33, 1.25)}
 

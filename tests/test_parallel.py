@@ -89,7 +89,8 @@ class TestFSDP:
                                             shard_state)
         from deeplearning_tpu.train.classification import make_loss_fn
         import optax
-        model = MODELS.build("mnist_fcn", num_classes=4)
+        model = MODELS.build("mnist_fcn", num_classes=4,
+                             dtype=jnp.float32)
         params = model.init(jax.random.key(0),
                             jnp.zeros((1, 28, 28, 1)),
                             train=False)["params"]

@@ -56,6 +56,19 @@ class TestYOLOv5:
         assert abs(anchors[0].mean() - 32) < 15
         assert abs(anchors[-1].mean() - 128) < 20
 
+    def test_check_anchors_bpr(self):
+        # perfect anchors -> BPR 1; anchors off by > thr ratio -> BPR 0
+        wh = np.array([[32.0, 32.0], [64.0, 64.0]])
+        fit = Y5.check_anchors(wh, np.array([[32, 32], [64, 64]]))
+        assert fit["bpr"] == 1.0 and fit["aat"] >= 1.0
+        # 128-anchor: matches 64 (ratio 2 < 4) but not 32 (ratio 4,
+        # gate is strict) -> BPR 0.5; 1024-anchor matches nothing
+        half = Y5.check_anchors(wh, np.array([[128.0, 128.0]]), thr=4.0)
+        assert half["bpr"] == 0.5
+        worse = Y5.check_anchors(wh, np.array([[1024.0, 1024.0]]),
+                                 thr=4.0)
+        assert worse["bpr"] == 0.0
+
     def test_postprocess(self):
         grid = {k: jnp.asarray(v) for k, v in
                 Y5.yolov5_grid((64, 64)).items()}

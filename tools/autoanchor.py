@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Autoanchor CLI: check/recompute YOLOv5 anchors for a COCO-json dataset.
+
+  python tools/autoanchor.py --coco instances.json --img-size 640
+  python tools/autoanchor.py --coco instances.json --n 9 --force
+
+The yolov5 autoanchor surface (utils/autoanchor.py: check_anchors BPR
+gate + kmean_anchors recompute) as a standalone tool: loads gt boxes,
+scales wh to the training image size, prints the current anchors' best
+possible recall, and proposes k-means anchors when BPR < 0.98 (or
+always with --force).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def gt_wh_from_coco(path: str, img_size: int) -> np.ndarray:
+    """(G, 2) gt widths/heights scaled as training would resize them
+    (longest side -> img_size, aspect preserved)."""
+    from deeplearning_tpu.data.coco import load_coco_json
+    records, _ = load_coco_json(path)
+    whs = []
+    for rec in records:
+        scale = img_size / max(rec["height"], rec["width"])
+        for box in rec["boxes"]:
+            x0, y0, x1, y1 = box
+            whs.append(((x1 - x0) * scale, (y1 - y0) * scale))
+    return np.asarray(whs, np.float64)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coco", required=True, help="instances.json")
+    ap.add_argument("--img-size", type=int, default=640)
+    ap.add_argument("--n", type=int, default=9, help="anchor count")
+    ap.add_argument("--thr", type=float, default=4.0,
+                    help="wh ratio threshold (hyp anchor_t)")
+    ap.add_argument("--force", action="store_true",
+                    help="recompute even when BPR >= 0.98")
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.models.detection.yolov5 import (DEFAULT_ANCHORS,
+                                                          check_anchors,
+                                                          kmean_anchors)
+
+    wh = gt_wh_from_coco(args.coco, args.img_size)
+    if len(wh) == 0:
+        raise SystemExit("no gt boxes in the dataset")
+    current = np.asarray(DEFAULT_ANCHORS, np.float64).reshape(-1, 2)
+    fit = check_anchors(wh, current, thr=args.thr)
+    print(f"current anchors: BPR={fit['bpr']:.4f} "
+          f"anchors/target={fit['aat']:.2f} over {len(wh)} gts")
+    if fit["bpr"] >= 0.98 and not args.force:
+        print("BPR >= 0.98 — current anchors are fine "
+              "(yolov5 check_anchors gate)")
+        return 0
+    proposed = kmean_anchors(wh, n=args.n)
+    pfit = check_anchors(wh, proposed, thr=args.thr)
+    print(f"k-means anchors: BPR={pfit['bpr']:.4f} "
+          f"anchors/target={pfit['aat']:.2f}")
+    for row in proposed.round(1):
+        print(f"  [{row[0]:.1f}, {row[1]:.1f}]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
