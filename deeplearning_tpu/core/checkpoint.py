@@ -28,31 +28,61 @@ from .logging import create_logger
 
 
 class CheckpointManager:
-    """Step-numbered checkpoints + best tracking + auto-resume."""
+    """Step-numbered checkpoints + best tracking + auto-resume.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    ``async_save=True`` enables Orbax async checkpointing: ``save``
+    snapshots device arrays and returns while the host write happens on
+    a background thread, so the train loop keeps stepping during I/O —
+    the TPU-native answer to the reference's blocking per-epoch
+    ``torch.save`` (training stalls for the full serialize+write there).
+    In-flight writes are awaited before the next save, before any
+    best-copy, and on close()."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = False):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True,
-                best_fn=None, enable_async_checkpointing=False),
+                best_fn=None, enable_async_checkpointing=async_save),
         )
+        self._async = async_save
+        self._pending_best: Optional[int] = None
         self._logger = create_logger()
+
+    def _finish_pending_best(self) -> None:
+        if self._pending_best is None or jax.process_index() != 0:
+            self._pending_best = None
+            return
+        step, self._pending_best = self._pending_best, None
+        best = os.path.join(self.directory, "best")
+        src = os.path.join(self.directory, str(step))
+        if os.path.isdir(src):
+            if os.path.isdir(best):
+                shutil.rmtree(best)
+            shutil.copytree(src, best)
 
     def save(self, step: int, state: Any, metrics: Optional[Dict] = None,
              is_best: bool = False) -> None:
+        if self._pending_best is not None:
+            # the previous async write has committed by now; copy its
+            # best BEFORE this save can trigger max_to_keep GC of it
+            self._mgr.wait_until_finished()
+            self._finish_pending_best()
         self._mgr.save(step, args=ocp.args.StandardSave(state),
                        metrics=metrics)
+        if not self._async:
+            self._mgr.wait_until_finished()
+        if is_best:
+            self._pending_best = step
+            if not self._async:
+                self._finish_pending_best()
+
+    def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
-        if is_best and jax.process_index() == 0:
-            best = os.path.join(self.directory, "best")
-            src = os.path.join(self.directory, str(step))
-            if os.path.isdir(src):
-                if os.path.isdir(best):
-                    shutil.rmtree(best)
-                shutil.copytree(src, best)
+        self._finish_pending_best()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -75,6 +105,7 @@ class CheckpointManager:
         return self.restore(state, step), step
 
     def close(self) -> None:
+        self.wait_until_finished()
         self._mgr.close()
 
 

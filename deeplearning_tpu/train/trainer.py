@@ -65,6 +65,7 @@ class Trainer:
         callbacks: Optional[Callbacks] = None,
         metric_reducer: Optional[Callable[[Dict], Dict]] = None,
         abort_non_finite: bool = True,
+        async_checkpoint: bool = False,
     ):
         self.state = state
         self.train_step = train_step
@@ -85,7 +86,8 @@ class Trainer:
         self.meters = MetricLogger()
         self.rng = rng_mod.host_key(seed)
         self.epoch = 0
-        self.ckpt = (CheckpointManager(f"{workdir}/ckpt")
+        self.ckpt = (CheckpointManager(f"{workdir}/ckpt",
+                                       async_save=async_checkpoint)
                      if workdir else None)
 
     # ------------------------------------------------------------- train
@@ -97,16 +99,23 @@ class Trainer:
                 steps_per_epoch = max(len(self.train_loader), 1)
                 self.epoch = int(step) // steps_per_epoch
         self.callbacks.fire("before_train", self)
-        for epoch in range(self.epoch, self.epochs):
-            self.epoch = epoch
-            self.callbacks.fire("before_epoch", self)
-            self._train_one_epoch(epoch)
-            self.callbacks.fire("after_epoch", self)
-            if self.eval_step and self.eval_loader is not None and \
-                    (epoch + 1) % self.eval_every == 0:
-                self.evaluate()
-            if self.ckpt and (epoch + 1) % self.save_every == 0:
-                self._save()
+        try:
+            for epoch in range(self.epoch, self.epochs):
+                self.epoch = epoch
+                self.callbacks.fire("before_epoch", self)
+                self._train_one_epoch(epoch)
+                self.callbacks.fire("after_epoch", self)
+                if self.eval_step and self.eval_loader is not None and \
+                        (epoch + 1) % self.eval_every == 0:
+                    self.evaluate()
+                if self.ckpt and (epoch + 1) % self.save_every == 0:
+                    self._save()
+        finally:
+            # land any in-flight async write + pending best-copy even on
+            # abort (non-finite guard, preemption) BEFORE callbacks that
+            # might read the best dir
+            if self.ckpt:
+                self.ckpt.wait_until_finished()
         self.callbacks.fire("after_train", self)
         self.tb.close()
         return self.state

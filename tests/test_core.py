@@ -205,3 +205,31 @@ class TestHub:
         a = jax.tree.leaves(v2["params"])[0]
         b = jax.tree.leaves(variables["params"])[0]
         np.testing.assert_allclose(np.asarray(a), np.asarray(b) + 1.0)
+
+
+class TestAsyncCheckpoint:
+    def test_async_best_survives_gc_and_holds_best_data(self, tmp_path):
+        import os
+        import jax.numpy as jnp
+        import numpy as np
+        from deeplearning_tpu.core.checkpoint import (CheckpointManager,
+                                                      load_pytree)
+        mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2,
+                                async_save=True)
+        mgr.save(1, {"w": jnp.arange(4.0)}, is_best=True)
+        # enough later saves that max_to_keep GC deletes step 1
+        for step in (2, 3, 4):
+            mgr.save(step, {"w": jnp.arange(4.0) + step})
+        mgr.wait_until_finished()
+        got = mgr.restore({"w": jnp.zeros(4)}, step=4)
+        np.testing.assert_allclose(np.asarray(got["w"]),
+                                   np.arange(4.0) + 4)
+        # the best dir exists AND holds step 1's data, even though
+        # step 1's own dir was garbage-collected
+        best = str(tmp_path / "ck" / "best")
+        assert os.path.isdir(best)
+        assert not os.path.isdir(str(tmp_path / "ck" / "1"))
+        restored = load_pytree(best)
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(4.0))
+        mgr.close()

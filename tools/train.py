@@ -77,6 +77,7 @@ class TrainCfg:
     seq_parallel: str = "ring"       # ring | ulysses (transformers only)
     accum_steps: int = 1             # gradient accumulation microbatches
     mixup: bool = False              # mixup/cutmix soft targets
+    async_checkpoint: bool = False   # overlap Orbax writes with training
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +221,7 @@ def main(argv=None) -> int:
         epochs=cfg.train.epochs,
         seed=cfg.train.seed,
         workdir=cfg.train.workdir,
+        async_checkpoint=cfg.train.async_checkpoint,
         log_every=max(steps_per_epoch // 2, 1))
     trainer.train()
     results = trainer.evaluate()
