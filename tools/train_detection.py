@@ -62,6 +62,8 @@ class DetTrainCfg:
     clip_grad_norm: float = 1.0
     seed: int = 0
     eval_score_thresh: float = 0.3
+    eval_tta: bool = False            # ALSO eval with multi-scale+flip
+                                      # TTA (YOLOX family only)
     multiscale: bool = False          # bucketed random_resize schedule
     multiscale_min: float = 0.75      # bucket range as ratios of image_size
     multiscale_max: float = 1.25
@@ -302,6 +304,10 @@ def run(cfg) -> dict:
 
     size = cfg.model.image_size
     num_classes = cfg.model.num_classes
+    if cfg.train.eval_tta and not cfg.model.name.startswith("yolox"):
+        raise ValueError("train.eval_tta currently supports the "
+                         "YOLOX family")   # fail BEFORE training
+    eval_max_det = 10
     train_src = val_src = None
     if cfg.data.coco:
         from deeplearning_tpu.data.coco import (coco_detection_source,
@@ -346,7 +352,8 @@ def run(cfg) -> dict:
     model = MODELS.build(cfg.model.name, num_classes=model_classes)
     loss_fn_task, predict_fn = build_task(model, cfg.model.name,
                                           num_classes,
-                                          cfg.train.eval_score_thresh)
+                                          cfg.train.eval_score_thresh,
+                                          max_det=eval_max_det)
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
@@ -404,41 +411,56 @@ def run(cfg) -> dict:
             print(f"step {it}: loss={float(total):.4f}")
 
     # ---- evaluate: coco mode on the held-out split, else train set
-    ev = CocoEvaluator(num_classes=num_classes)
-    predict_jit = jax.jit(predict_fn)
-    if val_src is not None:
-        bs = cfg.data.batch
-        n_val = len(val_src)
-        for start in range(0, n_val, bs):
-            # pad the tail chunk to the jitted batch shape, score only
-            # the real images
-            idx = np.minimum(np.arange(start, start + bs), n_val - 1)
-            n_real = min(bs, n_val - start)
-            sample = val_src[idx]
-            det = predict_jit(params, stats,
-                              jnp.asarray(sample["image"]))
-            for j in range(n_real):
-                keep = np.asarray(det["valid"][j])
-                gv = sample["valid"][j]
+    def eval_with(pred_fn, tag=""):
+        ev = CocoEvaluator(num_classes=num_classes)
+        pred_jit = jax.jit(pred_fn)
+        if val_src is not None:
+            bs = cfg.data.batch
+            n_val = len(val_src)
+            for start in range(0, n_val, bs):
+                # pad the tail chunk to the jitted batch shape, score
+                # only the real images
+                idx = np.minimum(np.arange(start, start + bs), n_val - 1)
+                n_real = min(bs, n_val - start)
+                sample = val_src[idx]
+                det = pred_jit(params, stats,
+                               jnp.asarray(sample["image"]))
+                for j in range(n_real):
+                    keep = np.asarray(det["valid"][j])
+                    gv = sample["valid"][j]
+                    ev.add_image(
+                        start + j,
+                        gt_boxes=sample["boxes"][j][gv],
+                        gt_labels=sample["labels"][j][gv],
+                        det_boxes=np.asarray(det["boxes"][j])[keep],
+                        det_scores=np.asarray(det["scores"][j])[keep],
+                        det_labels=np.asarray(det["labels"][j])[keep])
+        else:
+            det = pred_jit(params, stats, jnp.asarray(images))
+            for i in range(len(images)):
+                keep = np.asarray(det["valid"][i])
                 ev.add_image(
-                    start + j,
-                    gt_boxes=sample["boxes"][j][gv],
-                    gt_labels=sample["labels"][j][gv],
-                    det_boxes=np.asarray(det["boxes"][j])[keep],
-                    det_scores=np.asarray(det["scores"][j])[keep],
-                    det_labels=np.asarray(det["labels"][j])[keep])
-    else:
-        det = predict_fn(params, stats, jnp.asarray(images))
-        for i in range(len(images)):
-            keep = np.asarray(det["valid"][i])
-            ev.add_image(
-                i, gt_boxes=boxes[i][valid[i]],
-                gt_labels=labels[i][valid[i]],
-                det_boxes=np.asarray(det["boxes"][i])[keep],
-                det_scores=np.asarray(det["scores"][i])[keep],
-                det_labels=np.asarray(det["labels"][i])[keep])
-    summary = ev.summarize()
-    print({k: round(v, 4) for k, v in summary.items()})
+                    i, gt_boxes=boxes[i][valid[i]],
+                    gt_labels=labels[i][valid[i]],
+                    det_boxes=np.asarray(det["boxes"][i])[keep],
+                    det_scores=np.asarray(det["scores"][i])[keep],
+                    det_labels=np.asarray(det["labels"][i])[keep])
+        summary = ev.summarize()
+        print(tag + str({k: round(v, 4) for k, v in summary.items()}))
+        return summary
+
+    summary = eval_with(predict_fn)
+    if cfg.train.eval_tta:
+        from deeplearning_tpu.ops.tta import yolox_tta
+
+        def predict_tta(p, st, imgs):
+            raw_fn = lambda x: model.apply(
+                {"params": p, "batch_stats": st}, x, train=False)
+            return yolox_tta(raw_fn, imgs,
+                             score_thresh=cfg.train.eval_score_thresh,
+                             max_det=eval_max_det)
+        summary_tta = eval_with(predict_tta, tag="TTA ")
+        summary = {**summary, "tta": summary_tta}
     return summary
 
 
