@@ -58,3 +58,54 @@ def test_mae_with_ring_attn_matches_plain():
         lambda v, x: ringed.apply(v, x, train=False, rng=rng))(
         variables, imgs)
     np.testing.assert_allclose(float(loss_r), float(loss_p), rtol=1e-4)
+
+
+def test_3d_parallel_train_step():
+    """DP x TP x SP composed in ONE train step: batch over data, params
+    over model (TRANSFORMER_TP_RULES), attention tokens over seq (ring
+    adapter). Loss must be finite and match the plain DP run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.parallel import MeshConfig, build_mesh
+    from deeplearning_tpu.parallel.ring_attention import make_ring_attn_fn
+    from deeplearning_tpu.parallel.sharding import (TRANSFORMER_TP_RULES,
+                                                    batch_sharding)
+    from deeplearning_tpu.train import (TrainState, make_train_step,
+                                        shard_state)
+    from deeplearning_tpu.train.classification import make_loss_fn
+    import optax
+
+    mesh = build_mesh(MeshConfig(data=2, model=2, seq=2))
+    g = np.random.default_rng(0)
+    batch = {"image": jnp.asarray(g.normal(size=(8, 32, 32, 3)),
+                                  jnp.float32),
+             "label": jnp.asarray(g.integers(0, 4, 8), jnp.int32)}
+
+    def build(attn_fn, msh, rules):
+        model = MODELS.build("vit_base_patch16_224", num_classes=4,
+                             img_size=32, patch_size=8, embed_dim=32,
+                             depth=2, num_heads=4, dtype=jnp.float32,
+                             attn_fn=attn_fn)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, 32, 32, 3)),
+                            train=False)["params"]
+        state = shard_state(
+            TrainState.create(apply_fn=model.apply, params=params,
+                              tx=optax.sgd(0.01)), msh, rules)
+        step = make_train_step(make_loss_fn(), mesh=msh)
+        data = jax.device_put(batch, batch_sharding(msh))
+        return step(state, data, jax.random.key(1))
+
+    state3, m3 = build(make_ring_attn_fn(mesh), mesh,
+                       TRANSFORMER_TP_RULES)
+    mesh_dp = build_mesh(MeshConfig(data=-1))
+    state1, m1 = build(None, mesh_dp, None)
+    assert np.isfinite(float(m3["loss"]))
+    np.testing.assert_allclose(float(m3["loss"]), float(m1["loss"]),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(state3.params),
+                    jax.tree.leaves(state1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3)
