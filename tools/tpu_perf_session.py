@@ -123,12 +123,27 @@ def stage4_window():
         o = jnp.einsum("bhnm,bhmd->bhnd", p, v)
         return jnp.moveaxis(o, 1, 2).reshape(bw, n, heads * d)
 
-    for name, fn in [("lax", lax_path), ("pallas", window_attention)]:
+    from deeplearning_tpu.ops.pallas.window_attention import (
+        window_attention_checkpointed)
+    variants = [("lax", lax_path), ("pallas", window_attention),
+                ("pallas_ckpt", window_attention_checkpointed)]
+    for name, fn in variants:
         try:
             dt = bench(jax.jit(fn), (qkv, bias)) * 1e3
             print(f"[window fwd {name}] {dt:.3f}ms", flush=True)
         except Exception as e:                       # noqa: BLE001
             print(f"[window fwd {name}] FAILED: {e}", flush=True)
+    # training path: fwd+bwd through each variant
+    for name, fn in [("lax", lax_path),
+                     ("pallas_ckpt", window_attention_checkpointed)]:
+        try:
+            g = jax.jit(jax.grad(
+                lambda qkv, bias, _f=fn: _f(qkv, bias)
+                .astype(jnp.float32).sum(), argnums=(0,)))
+            dt = bench(g, (qkv, bias)) * 1e3
+            print(f"[window bwd {name}] {dt:.3f}ms", flush=True)
+        except Exception as e:                       # noqa: BLE001
+            print(f"[window bwd {name}] FAILED: {e}", flush=True)
 
 
 def main():
