@@ -104,7 +104,7 @@ def stage3_attn_micro():
 
 def stage4_window():
     from deeplearning_tpu.ops.pallas.window_attention import (
-        window_attention)
+        window_attention, window_attention_checkpointed)
 
     # Swin-B stage-1 training shape: 224/4=56 → 64 windows of 7²=49
     # tokens, 4 heads d=32 (dim 128), batch 64 → BW=4096
@@ -123,8 +123,6 @@ def stage4_window():
         o = jnp.einsum("bhnm,bhmd->bhnd", p, v)
         return jnp.moveaxis(o, 1, 2).reshape(bw, n, heads * d)
 
-    from deeplearning_tpu.ops.pallas.window_attention import (
-        window_attention_checkpointed)
     variants = [("lax", lax_path), ("pallas", window_attention),
                 ("pallas_ckpt", window_attention_checkpointed)]
     for name, fn in variants:
@@ -137,9 +135,10 @@ def stage4_window():
     for name, fn in [("lax", lax_path),
                      ("pallas_ckpt", window_attention_checkpointed)]:
         try:
+            # grad w.r.t. qkv AND the trainable relative-position bias
             g = jax.jit(jax.grad(
                 lambda qkv, bias, _f=fn: _f(qkv, bias)
-                .astype(jnp.float32).sum(), argnums=(0,)))
+                .astype(jnp.float32).sum(), argnums=(0, 1)))
             dt = bench(g, (qkv, bias)) * 1e3
             print(f"[window bwd {name}] {dt:.3f}ms", flush=True)
         except Exception as e:                       # noqa: BLE001
