@@ -48,6 +48,26 @@ def make_cls(root: str, size: int = 64, quality: int = 90) -> int:
     return len(imgs)
 
 
+def _paste_digit(bg, imgs, labels, rng, side_range):
+    """Composite one random digit onto ``bg`` (max blend, textured bg)
+    and return (x0, y0, side, class_idx, won): ``won`` is the boolean
+    patch of pixels where the digit ACTUALLY shows after the max — the
+    ground truth for masks must follow the composite, not the ink."""
+    from PIL import Image
+    j = int(rng.integers(0, len(imgs)))
+    side = int(rng.integers(*side_range))
+    canvas = bg.shape[0]
+    digit = np.asarray(
+        Image.fromarray(imgs[j], "L").resize((side, side), Image.BICUBIC),
+        np.float32)
+    x0 = int(rng.integers(0, canvas - side))
+    y0 = int(rng.integers(0, canvas - side))
+    patch = bg[y0:y0 + side, x0:x0 + side]
+    won = (digit > patch) & (digit > 80)   # visible ink only
+    bg[y0:y0 + side, x0:x0 + side] = np.maximum(patch, digit)
+    return x0, y0, side, int(labels[j]), won
+
+
 def make_det(root: str, n_images: int = 800, canvas: int = 256,
              max_obj: int = 5, seed: int = 0) -> int:
     from PIL import Image
@@ -63,19 +83,11 @@ def make_det(root: str, n_images: int = 800, canvas: int = 256,
         bg = rng.normal(96, 24, (canvas, canvas)).clip(0, 255)
         n_obj = int(rng.integers(1, max_obj + 1))
         for _ in range(n_obj):
-            j = int(rng.integers(0, len(imgs)))
-            side = int(rng.integers(28, 72))
-            digit = np.asarray(
-                Image.fromarray(imgs[j], "L").resize((side, side),
-                                                     Image.BICUBIC),
-                np.float32)
-            x0 = int(rng.integers(0, canvas - side))
-            y0 = int(rng.integers(0, canvas - side))
-            patch = bg[y0:y0 + side, x0:x0 + side]
-            bg[y0:y0 + side, x0:x0 + side] = np.maximum(patch, digit)
+            x0, y0, side, cls, _ = _paste_digit(bg, imgs, labels, rng,
+                                                (28, 72))
             coco["annotations"].append({
                 "id": ann_id, "image_id": img_id,
-                "category_id": int(labels[j]) + 1,
+                "category_id": cls + 1,
                 "bbox": [x0, y0, side, side],   # COCO xywh
                 "area": side * side, "iscrowd": 0})
             ann_id += 1
@@ -89,20 +101,53 @@ def make_det(root: str, n_images: int = 800, canvas: int = 256,
     return n_images
 
 
+def make_seg(root: str, n_images: int = 400, canvas: int = 128,
+             max_obj: int = 4, seed: int = 0) -> int:
+    """Semantic-segmentation variant: composited digit scenes + per-pixel
+    class masks (0 = background, 1..10 = digit class + 1) in ONE npz —
+    the real-data path for tools/train_task.py --task segmentation."""
+    imgs, labels = load_digits_images()
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    # uint8 grayscale storage (12x smaller than f32 RGB); the loader
+    # expands to model-ready float RGB
+    xs = np.zeros((n_images, canvas, canvas), np.uint8)
+    ys = np.zeros((n_images, canvas, canvas), np.uint8)
+    for img_id in range(n_images):
+        bg = rng.normal(96, 24, (canvas, canvas)).clip(0, 255)
+        mask = np.zeros((canvas, canvas), np.uint8)
+        for _ in range(int(rng.integers(1, max_obj + 1))):
+            x0, y0, side, cls, won = _paste_digit(bg, imgs, labels, rng,
+                                                  (20, 56))
+            # label exactly the pixels the composite shows (won): no
+            # hidden-ink labels, later digits only claim where they win
+            mask[y0:y0 + side, x0:x0 + side][won] = cls + 1
+        xs[img_id] = bg.astype(np.uint8)
+        ys[img_id] = mask
+    out = os.path.join(root, "seg.npz")
+    np.savez_compressed(out, images=xs, masks=ys)
+    return n_images
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default=".data/digits")
     ap.add_argument("--which", default="both",
-                    choices=["cls", "det", "both"])
+                    choices=["cls", "det", "seg", "both", "all"])
     ap.add_argument("--det-images", type=int, default=800)
+    ap.add_argument("--seg-images", type=int, default=400)
     args = ap.parse_args()
-    if args.which in ("cls", "both"):
+    if args.which in ("cls", "both", "all"):
         n = make_cls(os.path.join(args.root, "cls"))
         print(f"cls: wrote {n} JPEGs under {args.root}/cls")
-    if args.which in ("det", "both"):
+    if args.which in ("det", "both", "all"):
         n = make_det(os.path.join(args.root, "det"),
                      n_images=args.det_images)
         print(f"det: wrote {n} composited scenes under {args.root}/det")
+    if args.which in ("seg", "all"):
+        n = make_seg(os.path.join(args.root, "seg"),
+                     n_images=args.seg_images)
+        print(f"seg: wrote {n} scenes+masks to {args.root}/seg/seg.npz")
 
 
 if __name__ == "__main__":

@@ -108,36 +108,77 @@ def run_segmentation(cfg: TaskConfig) -> int:
                                                      miou_from_confusion)
     from deeplearning_tpu.ops import losses as L
 
-    s = cfg.model.image_size
-    rng = np.random.default_rng(cfg.train.seed)
-    x = rng.normal(0, 0.1, (cfg.data.batch, s, s, 3)).astype(np.float32)
-    y = np.zeros((cfg.data.batch, s, s), np.int32)
-    for i in range(cfg.data.batch):
-        cx, cy, r = rng.integers(8, s - 8), rng.integers(8, s - 8), 6
-        yy, xx = np.mgrid[:s, :s]
-        m = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
-        y[i][m] = 1
-        x[i][m] += 1.0
-    x, y = jnp.asarray(x), jnp.asarray(y)
+    if cfg.data.npz:
+        # real-data path: npz with images (N,H,W,3) f32 and masks
+        # (N,H,W) int; first 10% held out for the mIoU report
+        blob = np.load(cfg.data.npz)
+        images, masks = blob["images"], blob["masks"].astype(np.int32)
+        if images.dtype == np.uint8:        # stored compact (make_digits)
+            images = images.astype(np.float32) / 255.0
+        if images.ndim == 3:                # grayscale -> RGB
+            images = np.repeat(images[..., None], 3, axis=-1)
+        num_classes = int(masks.max()) + 1
+        n_val = max(len(images) // 10, 1)
+        val_x, val_y = images[:n_val], masks[:n_val]
+        tr_x = jnp.asarray(images[n_val:])
+        tr_y = jnp.asarray(masks[n_val:])
+        b = min(cfg.data.batch, tr_x.shape[0])
 
-    model = MODELS.build(cfg.model.name or "unet", num_classes=2,
-                         dtype=jnp.float32)
-    variables = model.init(jax.random.key(0), x[:1], train=False)
+        def batch_at(i):
+            start = (i * b) % (tr_x.shape[0] - b + 1)
+            return (jax.lax.dynamic_slice_in_dim(tr_x, start, b),
+                    jax.lax.dynamic_slice_in_dim(tr_y, start, b))
+        init_x = tr_x[:1]
+    else:
+        s = cfg.model.image_size
+        rng = np.random.default_rng(cfg.train.seed)
+        x = rng.normal(0, 0.1, (cfg.data.batch, s, s, 3)).astype(
+            np.float32)
+        y = np.zeros((cfg.data.batch, s, s), np.int32)
+        for i in range(cfg.data.batch):
+            cx, cy, r = rng.integers(8, s - 8), rng.integers(8, s - 8), 6
+            yy, xx = np.mgrid[:s, :s]
+            m = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+            y[i][m] = 1
+            x[i][m] += 1.0
+        tr_x, tr_y = jnp.asarray(x), jnp.asarray(y)
+        val_x, val_y = x, y
+        num_classes = 2
+        batch_at = lambda i: (tr_x, tr_y)
+        init_x = tr_x[:1]
+
+    model = MODELS.build(cfg.model.name or "unet",
+                         num_classes=num_classes, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), init_x, train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
 
     def loss_fn(p, i):
-        out = model.apply({"params": p, "batch_stats": stats}, x,
+        bx, by = batch_at(i)
+        out = model.apply({"params": p, "batch_stats": stats}, bx,
                           train=False)
         logits = out[0] if isinstance(out, tuple) else out
-        return L.cross_entropy(logits, y) + L.dice_loss(logits, y)
+        return L.cross_entropy(logits, by) + L.dice_loss(logits, by)
 
     params, first, last = _loop(loss_fn, params, cfg.train.steps,
                                 cfg.train.lr)
-    out = model.apply({"params": params, "batch_stats": stats}, x,
-                      train=False)
-    logits = out[0] if isinstance(out, tuple) else out
-    mat = confusion_matrix(jnp.argmax(logits, -1), y, 2)
-    miou = miou_from_confusion(np.asarray(mat))["miou"]
+
+    @jax.jit
+    def predict(p, bx):
+        out = model.apply({"params": p, "batch_stats": stats}, bx,
+                          train=False)
+        return jnp.argmax(out[0] if isinstance(out, tuple) else out, -1)
+
+    mat = np.zeros((num_classes, num_classes), np.int64)
+    eb = min(cfg.data.batch, len(val_x))
+    for start in range(0, len(val_x), eb):
+        # pad the tail chunk to the jitted shape; count only real rows
+        idx = np.minimum(np.arange(start, start + eb), len(val_x) - 1)
+        n_real = min(eb, len(val_x) - start)
+        pred = predict(params, jnp.asarray(val_x[idx]))
+        mat += np.asarray(confusion_matrix(
+            pred[:n_real], jnp.asarray(val_y[idx][:n_real]),
+            num_classes))
+    miou = miou_from_confusion(mat)["miou"]
     print(f"task_metric miou={float(miou):.4f}")
     return 0 if np.isfinite(last) else 1
 
