@@ -10,6 +10,22 @@ from deeplearning_tpu.core.experiment import (EXPERIMENTS, BaseExp,
 from deeplearning_tpu.core.registry import MODELS
 
 
+def _tiny_swin_moe():
+    return MODELS.build("swin_moe_tiny_patch4_window7_224",
+                        num_classes=4, patch_size=2, embed_dim=32,
+                        depths=(2, 2), num_heads=(2, 4),
+                        num_experts=2, dtype=jnp.float32)
+
+
+def _moe_loss(model):
+    def loss(p, xx):
+        logits, aux = model.apply({"params": p}, xx, train=False,
+                                  mutable=["losses"])
+        ce = -jax.nn.log_softmax(logits)[:, 0].mean()
+        return ce + sum(jax.tree.leaves(aux["losses"]))
+    return loss
+
+
 class TestExpSystem:
     def test_registry_and_merge(self):
         exp = get_exp(exp_name="mnist_smoke")
@@ -42,10 +58,7 @@ class TestExpSystem:
 
 class TestSwinMoE:
     def test_forward_with_aux_losses(self):
-        model = MODELS.build("swin_moe_tiny_patch4_window7_224",
-                             num_classes=4, patch_size=2, embed_dim=32,
-                             depths=(2, 2), num_heads=(2, 4),
-                             num_experts=2, dtype=jnp.float32)
+        model = _tiny_swin_moe()
         x = jnp.asarray(np.random.default_rng(0).normal(
             size=(2, 56, 56, 3)), jnp.float32)
         variables = model.init(jax.random.key(0), x, train=False)
@@ -64,19 +77,12 @@ class TestSwinMoE:
         assert moe_kernels and all(k.shape[0] == 2 for k in moe_kernels)
 
     def test_trainable_with_aux_in_loss(self):
-        model = MODELS.build("swin_moe_tiny_patch4_window7_224",
-                             num_classes=4, patch_size=2, embed_dim=32,
-                             depths=(2, 2), num_heads=(2, 4),
-                             num_experts=2, dtype=jnp.float32)
+        model = _tiny_swin_moe()
         x = jnp.zeros((2, 56, 56, 3))
         variables = model.init(jax.random.key(0), x, train=False)
 
-        def loss(p):
-            logits, aux = model.apply({"params": p}, x, train=False,
-                                      mutable=["losses"])
-            ce = -jax.nn.log_softmax(logits)[:, 0].mean()
-            return ce + sum(jax.tree.leaves(aux["losses"]))
-        g = jax.grad(loss)(variables["params"])
+        loss = _moe_loss(model)
+        g = jax.grad(lambda p: loss(p, x))(variables["params"])
         leaves = [np.asarray(v, np.float64) for v in jax.tree.leaves(g)]
         assert all(np.isfinite(l).all() for l in leaves)
         assert max(np.abs(l).max() for l in leaves) > 0
@@ -88,21 +94,13 @@ class TestSwinMoE:
         from deeplearning_tpu.parallel.moe import MOE_RULES
         from deeplearning_tpu.parallel.sharding import (batch_sharding,
                                                         shard_params_tree)
-        model = MODELS.build("swin_moe_tiny_patch4_window7_224",
-                             num_classes=4, patch_size=2, embed_dim=32,
-                             depths=(2, 2), num_heads=(2, 4),
-                             num_experts=2, dtype=jnp.float32)
+        model = _tiny_swin_moe()
         x = jnp.asarray(np.random.default_rng(0).normal(
             size=(4, 56, 56, 3)), jnp.float32)
         variables = model.init(jax.random.key(0), x, train=False)
         params = variables["params"]
 
-        def loss(p, xx):
-            logits, aux = model.apply({"params": p}, xx, train=False,
-                                      mutable=["losses"])
-            ce = -jax.nn.log_softmax(logits)[:, 0].mean()
-            return ce + sum(jax.tree.leaves(aux["losses"]))
-
+        loss = _moe_loss(model)
         g_ref = jax.jit(jax.grad(loss))(params, x)
 
         mesh = build_mesh(MeshConfig(data=-1, expert=2))
