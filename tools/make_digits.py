@@ -129,11 +129,32 @@ def make_seg(root: str, n_images: int = 400, canvas: int = 128,
     return n_images
 
 
+def make_kp(root: str, n_images: int = 300, canvas: int = 128,
+            n_kp: int = 4, seed: int = 0) -> int:
+    """Keypoint variant: digit centers as keypoints (x, y, vis), padded
+    to ``n_kp`` slots — the real-data path for --task keypoints."""
+    imgs, labels = load_digits_images()
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    xs = np.zeros((n_images, canvas, canvas), np.uint8)
+    kps = np.zeros((n_images, n_kp, 3), np.float32)
+    for img_id in range(n_images):
+        bg = rng.normal(96, 24, (canvas, canvas)).clip(0, 255)
+        for slot in range(int(rng.integers(1, n_kp + 1))):
+            x0, y0, side, _, _ = _paste_digit(bg, imgs, labels, rng,
+                                              (20, 56))
+            kps[img_id, slot] = (x0 + side / 2, y0 + side / 2, 1.0)
+        xs[img_id] = bg.astype(np.uint8)
+    out = os.path.join(root, "kp.npz")
+    np.savez_compressed(out, images=xs, keypoints=kps)
+    return n_images
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default=".data/digits")
     ap.add_argument("--which", default="both",
-                    choices=["cls", "det", "seg", "both", "all"])
+                    choices=["cls", "det", "seg", "kp", "both", "all"])
     ap.add_argument("--det-images", type=int, default=800)
     ap.add_argument("--seg-images", type=int, default=400)
     args = ap.parse_args()
@@ -148,6 +169,9 @@ def main():
         n = make_seg(os.path.join(args.root, "seg"),
                      n_images=args.seg_images)
         print(f"seg: wrote {n} scenes+masks to {args.root}/seg/seg.npz")
+    if args.which in ("kp", "all"):
+        n = make_kp(os.path.join(args.root, "kp"))
+        print(f"kp: wrote {n} scenes+keypoints to {args.root}/kp/kp.npz")
 
 
 if __name__ == "__main__":

@@ -102,6 +102,28 @@ def _loop(loss_fn, params, steps, lr):
     return params, first, last
 
 
+def _load_npz_images(blob):
+    """images from an npz: uint8 -> [0,1] float, grayscale -> RGB."""
+    images = blob["images"]
+    if images.dtype == np.uint8:
+        images = images.astype(np.float32) / 255.0
+    if images.ndim == 3:
+        images = np.repeat(images[..., None], 3, axis=-1)
+    return images
+
+
+def _make_batcher(batch, *arrays):
+    """Deterministic wraparound minibatcher over equally-indexed arrays
+    (jit-safe: dynamic_slice with the traced step index)."""
+    b = min(batch, arrays[0].shape[0])
+
+    def batch_at(i):
+        start = (i * b) % (arrays[0].shape[0] - b + 1)
+        return tuple(jax.lax.dynamic_slice_in_dim(a, start, b)
+                     for a in arrays)
+    return batch_at
+
+
 def run_segmentation(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
@@ -112,22 +134,14 @@ def run_segmentation(cfg: TaskConfig) -> int:
         # real-data path: npz with images (N,H,W,3) f32 and masks
         # (N,H,W) int; first 10% held out for the mIoU report
         blob = np.load(cfg.data.npz)
-        images, masks = blob["images"], blob["masks"].astype(np.int32)
-        if images.dtype == np.uint8:        # stored compact (make_digits)
-            images = images.astype(np.float32) / 255.0
-        if images.ndim == 3:                # grayscale -> RGB
-            images = np.repeat(images[..., None], 3, axis=-1)
+        images = _load_npz_images(blob)
+        masks = blob["masks"].astype(np.int32)
         num_classes = int(masks.max()) + 1
         n_val = max(len(images) // 10, 1)
         val_x, val_y = images[:n_val], masks[:n_val]
         tr_x = jnp.asarray(images[n_val:])
         tr_y = jnp.asarray(masks[n_val:])
-        b = min(cfg.data.batch, tr_x.shape[0])
-
-        def batch_at(i):
-            start = (i * b) % (tr_x.shape[0] - b + 1)
-            return (jax.lax.dynamic_slice_in_dim(tr_x, start, b),
-                    jax.lax.dynamic_slice_in_dim(tr_y, start, b))
+        batch_at = _make_batcher(cfg.data.batch, tr_x, tr_y)
         init_x = tr_x[:1]
     else:
         s = cfg.model.image_size
@@ -285,40 +299,83 @@ def run_keypoints(cfg: TaskConfig) -> int:
                                                        pck)
     from deeplearning_tpu.ops import losses as L
 
-    s = max(cfg.model.image_size, 64)
-    k = 4
-    rng = np.random.default_rng(cfg.train.seed)
-    kps = rng.uniform(8, s - 8, (cfg.data.batch, k, 2)).astype(np.float32)
-    vis = np.ones((cfg.data.batch, k), np.float32)
-    x = np.zeros((cfg.data.batch, s, s, 3), np.float32)
-    for i in range(cfg.data.batch):
-        for j in range(k):
-            xx, yy = int(kps[i, j, 0]), int(kps[i, j, 1])
-            x[i, max(yy - 1, 0):yy + 2, max(xx - 1, 0):xx + 2, j % 3] = 2.0
-    target = jnp.asarray(np.stack([
-        make_heatmap_targets(kps[i], vis[i], (s // 4, s // 4), stride=4)
-        for i in range(cfg.data.batch)]))
-    x = jnp.asarray(x)
+    if cfg.data.npz:
+        # real-data path: npz with images (N,H,W[,3]) and keypoints
+        # (N,K,3) = (x, y, vis); heatmap targets precomputed host-side
+        blob = np.load(cfg.data.npz)
+        images = _load_npz_images(blob)
+        kps_all = blob["keypoints"].astype(np.float32)     # (N, K, 3)
+        h, w = images.shape[1:3]
+        s = max(h, w)                       # pck threshold scale
+        k = kps_all.shape[1]
+        vis_all = kps_all[..., 2]
+        n_val = max(len(images) // 10, 1)
+        val = (images[:n_val], kps_all[:n_val], vis_all[:n_val])
+        # targets only for the TRAINING slice (val scores via pck)
+        targets = np.stack([
+            make_heatmap_targets(kps_all[i, :, :2], vis_all[i],
+                                 (h // 4, w // 4), stride=4)
+            for i in range(n_val, len(images))])
+        tr_x = jnp.asarray(images[n_val:])
+        tr_t = jnp.asarray(targets)
+        tr_v = jnp.asarray(vis_all[n_val:])
+        batch_at = _make_batcher(cfg.data.batch, tr_x, tr_t, tr_v)
+        init_x = tr_x[:1]
+    else:
+        s = max(cfg.model.image_size, 64)
+        k = 4
+        rng = np.random.default_rng(cfg.train.seed)
+        kps = rng.uniform(8, s - 8,
+                          (cfg.data.batch, k, 2)).astype(np.float32)
+        vis = np.ones((cfg.data.batch, k), np.float32)
+        x = np.zeros((cfg.data.batch, s, s, 3), np.float32)
+        for i in range(cfg.data.batch):
+            for j in range(k):
+                xx, yy = int(kps[i, j, 0]), int(kps[i, j, 1])
+                x[i, max(yy - 1, 0):yy + 2,
+                  max(xx - 1, 0):xx + 2, j % 3] = 2.0
+        target = jnp.asarray(np.stack([
+            make_heatmap_targets(kps[i], vis[i], (s // 4, s // 4),
+                                 stride=4)
+            for i in range(cfg.data.batch)]))
+        tr_x = jnp.asarray(x)
+        vis_j = jnp.asarray(vis)
+        batch_at = lambda i: (tr_x, target, vis_j)
+        val = (x, np.concatenate([kps, vis[..., None]], -1), vis)
+        init_x = tr_x[:1]
 
     model = MODELS.build(cfg.model.name or "hrnet_w18_keypoints",
                          num_classes=k, dtype=jnp.float32)
-    variables = model.init(jax.random.key(0), x[:1], train=False)
+    variables = model.init(jax.random.key(0), init_x, train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
 
     def loss_fn(p, i):
-        heat = model.apply({"params": p, "batch_stats": stats}, x,
+        bx, bt, bv = batch_at(i)
+        heat = model.apply({"params": p, "batch_stats": stats}, bx,
                            train=False)
-        return L.heatmap_mse_loss(heat, target, jnp.asarray(vis))
+        return L.heatmap_mse_loss(heat, bt, bv)
 
     params, first, last = _loop(loss_fn, params, cfg.train.steps,
                                 cfg.train.lr)
-    heat = model.apply({"params": params, "batch_stats": stats}, x,
-                       train=False)
-    pred, _ = decode_heatmaps(heat, stride=4)
-    pred = np.asarray(pred)
-    score = float(np.mean([pck(pred[i], kps[i], vis[i],
-                               threshold_px=s * 0.2)
-                           for i in range(len(pred))]))
+
+    val_x, val_kp, val_vis = val
+
+    @jax.jit
+    def predict(p, bx):
+        heat = model.apply({"params": p, "batch_stats": stats}, bx,
+                           train=False)
+        return decode_heatmaps(heat, stride=4)[0]
+
+    eb = min(cfg.data.batch, len(val_x))
+    scores = []
+    for start in range(0, len(val_x), eb):
+        idx = np.minimum(np.arange(start, start + eb), len(val_x) - 1)
+        n_real = min(eb, len(val_x) - start)
+        pred = np.asarray(predict(params, jnp.asarray(val_x[idx])))
+        scores.extend(pck(pred[i], val_kp[idx[i], :, :2],
+                          val_vis[idx[i]], threshold_px=s * 0.2)
+                      for i in range(n_real))
+    score = float(np.mean(scores))
     print(f"task_metric pck@0.2={float(score):.4f}")
     return 0 if np.isfinite(last) else 1
 
