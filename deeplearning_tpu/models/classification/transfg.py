@@ -102,8 +102,8 @@ def contrastive_loss(embeddings: jax.Array, labels: jax.Array,
     """TransFG contrastive loss (losses/contrastive_loss.py): pull same-
     class CLS embeddings together, push different-class pairs past a
     cosine margin."""
-    z = embeddings / (jnp.linalg.norm(embeddings, axis=-1,
-                                      keepdims=True) + 1e-12)
+    from ...ops.losses import safe_normalize
+    z = safe_normalize(embeddings, axis=-1)   # NaN-safe at zero rows
     sim = z @ z.T
     same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
     eye = jnp.eye(len(labels))

@@ -27,8 +27,9 @@ def masked_average_pool(feats: jax.Array, mask: jax.Array) -> jax.Array:
 
 def cosine_similarity_map(feats: jax.Array, proto: jax.Array) -> jax.Array:
     """(B, H, W, C) × (B, C) → (B, H, W) cosine similarity."""
-    f = feats / (jnp.linalg.norm(feats, axis=-1, keepdims=True) + 1e-6)
-    p = proto / (jnp.linalg.norm(proto, axis=-1, keepdims=True) + 1e-6)
+    from ...ops.losses import safe_normalize
+    f = safe_normalize(feats, axis=-1)   # NaN-safe at zero features
+    p = safe_normalize(proto, axis=-1)
     return jnp.einsum("bhwc,bc->bhw", f, p)
 
 

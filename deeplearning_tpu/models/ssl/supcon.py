@@ -43,7 +43,8 @@ class SupConModel(nn.Module):
         z = nn.relu(z)
         z = nn.Dense(self.proj_dim, dtype=self.dtype, name="proj2")(z)
         z = z.astype(jnp.float32)
-        z = z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-12)
+        from ...ops.losses import safe_normalize
+        z = safe_normalize(z, axis=-1)   # NaN-safe at z == 0
         logits = None
         if self.num_classes > 0:
             logits = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -180,14 +180,24 @@ def triplet_loss(embeddings: jax.Array, labels: jax.Array,
     return _weighted_mean(loss, has_both)
 
 
+def safe_normalize(x: jax.Array, axis: int = -1,
+                   eps: float = 1e-6) -> jax.Array:
+    """L2-normalize with a finite gradient at x == 0: ``jnp.linalg.norm``
+    differentiates to NaN at exactly zero (sqrt'(0)), and an untrained
+    ReLU backbone CAN emit an all-zero embedding for a dark image —
+    rsqrt(max(|x|^2, eps^2)) keeps the zero row zero with gradient x/eps."""
+    sq = jnp.sum(x * x, axis=axis, keepdims=True)
+    return x * jax.lax.rsqrt(jnp.maximum(sq, eps * eps))
+
+
 def arcface_logits(embeddings: jax.Array, weight: jax.Array,
                    labels: jax.Array, s: float = 64.0, m: float = 0.5
                    ) -> jax.Array:
     """ArcFace margin logits (Happy-Whale arcFaceloss.py:6: s=64, m=0.5).
     embeddings: (B,D); weight: (D,C) class centers. Returns scaled logits
     to feed cross_entropy."""
-    emb = embeddings / (jnp.linalg.norm(embeddings, axis=-1, keepdims=True) + 1e-12)
-    w = weight / (jnp.linalg.norm(weight, axis=0, keepdims=True) + 1e-12)
+    emb = safe_normalize(embeddings, axis=-1)
+    w = safe_normalize(weight, axis=0)
     cos = jnp.clip(emb @ w, -1 + 1e-7, 1 - 1e-7)
     theta = jnp.arccos(cos)
     target_cos = jnp.cos(theta + m)
@@ -199,9 +209,8 @@ def wnfc_logits(embeddings: jax.Array, weight: jax.Array,
                 s: float = 64.0) -> jax.Array:
     """Weight-normalized FC logits (Happy-Whale arcFaceloss.py:58 wnfc):
     cosine classifier without the angular margin — scaled cos(theta)."""
-    emb = embeddings / (jnp.linalg.norm(embeddings, axis=-1,
-                                        keepdims=True) + 1e-12)
-    w = weight / (jnp.linalg.norm(weight, axis=0, keepdims=True) + 1e-12)
+    emb = safe_normalize(embeddings, axis=-1)
+    w = safe_normalize(weight, axis=0)
     return s * (emb @ w)
 
 

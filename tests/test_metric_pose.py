@@ -128,3 +128,31 @@ class TestPose:
                  for i, g in enumerate(gts)]
         res = oks_ap(preds, gts)
         assert 0.5 < res["AP50"] < 0.8            # 3 of 4 found (~0.752)
+
+
+class TestAngularLossGradSafety:
+    def test_zero_embedding_row_keeps_grads_finite(self):
+        """An untrained ReLU backbone CAN emit an all-zero embedding;
+        jnp.linalg.norm differentiates to NaN at 0, so the angular
+        losses must use the safe normalize (rsqrt(max(|x|^2, eps^2)))."""
+        from deeplearning_tpu.ops.losses import (arcface_logits,
+                                                 cross_entropy,
+                                                 wnfc_logits)
+        rng = np.random.default_rng(0)
+        emb = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        emb = emb.at[1].set(0.0)                    # the killer row
+        w = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+        y = jnp.asarray([0, 1, 2, 0])
+
+        for fn in (lambda e: cross_entropy(arcface_logits(e, w, y), y),
+                   lambda e: cross_entropy(wnfc_logits(e, w), y)):
+            g = jax.grad(fn)(emb)
+            assert np.isfinite(np.asarray(g)).all()
+        # zero row: cos = 0 everywhere, so non-target logits are 0 and
+        # the target entry is s*cos(pi/2 + m) (margin applied to theta=90deg)
+        logits = np.asarray(arcface_logits(emb, w, y))
+        assert np.isfinite(logits).all()
+        np.testing.assert_allclose(np.delete(logits[1], 1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(logits[1, 1],
+                                   64.0 * np.cos(np.pi / 2 + 0.5),
+                                   rtol=1e-5)

@@ -157,6 +157,7 @@ def main():
                     choices=["cls", "det", "seg", "kp", "both", "all"])
     ap.add_argument("--det-images", type=int, default=800)
     ap.add_argument("--seg-images", type=int, default=400)
+    ap.add_argument("--kp-images", type=int, default=300)
     args = ap.parse_args()
     if args.which in ("cls", "both", "all"):
         n = make_cls(os.path.join(args.root, "cls"))
@@ -170,7 +171,8 @@ def main():
                      n_images=args.seg_images)
         print(f"seg: wrote {n} scenes+masks to {args.root}/seg/seg.npz")
     if args.which in ("kp", "all"):
-        n = make_kp(os.path.join(args.root, "kp"))
+        n = make_kp(os.path.join(args.root, "kp"),
+                    n_images=args.kp_images)
         print(f"kp: wrote {n} scenes+keypoints to {args.root}/kp/kp.npz")
 
 

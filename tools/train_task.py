@@ -124,6 +124,16 @@ def _make_batcher(batch, *arrays):
     return batch_at
 
 
+def _chunked_apply(n_total, batch):
+    """Yield (idx, n_real) chunks covering [0, n_total) at a fixed jit
+    batch shape: tail chunks pad by clamping to the last index and the
+    caller counts only the first n_real rows."""
+    eb = min(batch, n_total)
+    for start in range(0, n_total, eb):
+        idx = np.minimum(np.arange(start, start + eb), n_total - 1)
+        yield idx, min(eb, n_total - start)
+
+
 def run_segmentation(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
@@ -183,11 +193,7 @@ def run_segmentation(cfg: TaskConfig) -> int:
         return jnp.argmax(out[0] if isinstance(out, tuple) else out, -1)
 
     mat = np.zeros((num_classes, num_classes), np.int64)
-    eb = min(cfg.data.batch, len(val_x))
-    for start in range(0, len(val_x), eb):
-        # pad the tail chunk to the jitted shape; count only real rows
-        idx = np.minimum(np.arange(start, start + eb), len(val_x) - 1)
-        n_real = min(eb, len(val_x) - start)
+    for idx, n_real in _chunked_apply(len(val_x), cfg.data.batch):
         pred = predict(params, jnp.asarray(val_x[idx]))
         mat += np.asarray(confusion_matrix(
             pred[:n_real], jnp.asarray(val_y[idx][:n_real]),
@@ -257,31 +263,72 @@ def run_metric(cfg: TaskConfig) -> int:
 
     s = cfg.model.image_size
     rng = np.random.default_rng(cfg.train.seed)
-    n_id = cfg.model.num_classes
-    labels = np.repeat(np.arange(n_id), max(cfg.data.batch // n_id, 2))
-    x = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
-    for i, lab in enumerate(labels):
-        x[i, :, lab * 4 % s:(lab * 4 % s) + 3, :] += 1.5
-    x, y = jnp.asarray(x), jnp.asarray(labels)
+    if cfg.data.npz:
+        # real-data path: npz with images (N,H,W[,3]) and labels (N,)
+        # identity labels; PK-style batches come from the wraparound
+        # batcher over a label-sorted order (ids stay adjacent)
+        blob = np.load(cfg.data.npz)
+        images = _load_npz_images(blob)
+        labels_all = blob["labels"].astype(np.int32)
+        # PK-style order: K=2 same-id instances adjacent, ids cycling —
+        # every wraparound batch then has both positives AND negatives
+        # (a label-sorted order would give all-same-id batches: the
+        # triplet loss degenerates with no negatives)
+        by_id = np.argsort(labels_all, kind="stable")
+        within = np.zeros(len(labels_all), np.int64)
+        counts = {}
+        for pos, idx in enumerate(by_id):
+            c = int(labels_all[idx])
+            within[pos] = counts.get(c, 0)
+            counts[c] = counts.get(c, 0) + 1
+        order = by_id[np.lexsort((within % 2, labels_all[by_id],
+                                  within // 2))]
+        images, labels_all = images[order], labels_all[order]
+        n_id = int(labels_all.max()) + 1
+        tr_x = jnp.asarray(images)
+        tr_y = jnp.asarray(labels_all)
+        batch_at = _make_batcher(cfg.data.batch, tr_x, tr_y)
+        x, y = tr_x, tr_y          # eval embeds the whole set below
+        init_x = tr_x[:1]
+    else:
+        n_id = cfg.model.num_classes
+        labels = np.repeat(np.arange(n_id),
+                           max(cfg.data.batch // n_id, 2))
+        xx = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(
+            np.float32)
+        for i, lab in enumerate(labels):
+            xx[i, :, lab * 4 % s:(lab * 4 % s) + 3, :] += 1.5
+        x, y = jnp.asarray(xx), jnp.asarray(labels)
+        batch_at = lambda i: (x, y)
+        init_x = x[:1]
 
     model = MODELS.build(cfg.model.name or "arcface_resnet18",
                          num_classes=n_id, dtype=jnp.float32)
-    variables = model.init(jax.random.key(0), x[:1], train=False)
+    variables = model.init(jax.random.key(0), init_x, train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
 
     def loss_fn(p, i):
-        out = model.apply({"params": p, "batch_stats": stats}, x,
+        bx, by = batch_at(i)
+        out = model.apply({"params": p, "batch_stats": stats}, bx,
                           train=False)
         emb, centers = out["embedding"], out["centers"]
-        logits = L.arcface_logits(emb, centers, y)
-        return L.cross_entropy(logits, y) + L.triplet_loss(emb, y,
-                                                           margin=0.3)
+        logits = L.arcface_logits(emb, centers, by)
+        return L.cross_entropy(logits, by) + L.triplet_loss(emb, by,
+                                                            margin=0.3)
 
     params, first, last = _loop(loss_fn, params, cfg.train.steps,
                                 cfg.train.lr)
-    out = model.apply({"params": params, "batch_stats": stats}, x,
-                      train=False)
-    emb = np.asarray(out["embedding"])
+
+    @jax.jit
+    def embed(p, bx):
+        return model.apply({"params": p, "batch_stats": stats}, bx,
+                           train=False)["embedding"]
+
+    chunks = []
+    for idx, n_real in _chunked_apply(x.shape[0], cfg.data.batch):
+        chunks.append(np.asarray(embed(params,
+                                       jnp.asarray(x[idx])))[:n_real])
+    emb = np.concatenate(chunks)
     # interleave query/gallery so every query id appears in the gallery
     # (a contiguous split would separate the id sets -> vacuous metric)
     q, g = emb[0::2], emb[1::2]
@@ -366,11 +413,8 @@ def run_keypoints(cfg: TaskConfig) -> int:
                            train=False)
         return decode_heatmaps(heat, stride=4)[0]
 
-    eb = min(cfg.data.batch, len(val_x))
     scores = []
-    for start in range(0, len(val_x), eb):
-        idx = np.minimum(np.arange(start, start + eb), len(val_x) - 1)
-        n_real = min(eb, len(val_x) - start)
+    for idx, n_real in _chunked_apply(len(val_x), cfg.data.batch):
         pred = np.asarray(predict(params, jnp.asarray(val_x[idx])))
         scores.extend(pck(pred[i], val_kp[idx[i], :, :2],
                           val_vis[idx[i]], threshold_px=s * 0.2)
