@@ -81,10 +81,9 @@ class WindowAttention(nn.Module):
             bias = 16.0 * nn.sigmoid(cpb[rel_coords.reshape(-1)])
             bias = bias.reshape(n, n, self.num_heads).transpose(2, 0, 1)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            qn = q / (jnp.linalg.norm(q.astype(jnp.float32), axis=-1,
-                                      keepdims=True) + 1e-6)
-            kn = k / (jnp.linalg.norm(k.astype(jnp.float32), axis=-1,
-                                      keepdims=True) + 1e-6)
+            from ...ops.losses import safe_normalize
+            qn = safe_normalize(q.astype(jnp.float32), axis=-1)
+            kn = safe_normalize(k.astype(jnp.float32), axis=-1)
             scale = jnp.exp(jnp.minimum(logit_scale, jnp.log(100.0)))
             s = jnp.einsum("bqhd,bkhd->bhqk", qn, kn).astype(jnp.float32)
             s = s * scale[None] + bias[None]
