@@ -134,6 +134,21 @@ def _chunked_apply(n_total, batch):
         yield idx, min(eb, n_total - start)
 
 
+def _pk_order(labels_all):
+    """K=2 same-id instances adjacent, ids cycling — every wraparound
+    batch then has both positives AND negatives (a label-sorted order
+    degenerates contrastive/triplet objectives: no negatives)."""
+    by_id = np.argsort(labels_all, kind="stable")
+    within = np.zeros(len(labels_all), np.int64)
+    counts = {}
+    for pos, idx in enumerate(by_id):
+        c = int(labels_all[idx])
+        within[pos] = counts.get(c, 0)
+        counts[c] = counts.get(c, 0) + 1
+    return by_id[np.lexsort((within % 2, labels_all[by_id],
+                             within // 2))]
+
+
 def run_segmentation(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.evaluation.metrics import (confusion_matrix,
@@ -206,18 +221,28 @@ def run_segmentation(cfg: TaskConfig) -> int:
 def run_mae(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
 
-    s = max(cfg.model.image_size, 32)
-    x = jnp.asarray(np.random.default_rng(cfg.train.seed).normal(
-        size=(cfg.data.batch, s, s, 3)), jnp.float32)
+    if cfg.data.npz:
+        # real-data pretraining: npz images, wraparound minibatches
+        images = _load_npz_images(np.load(cfg.data.npz))
+        tr_x = jnp.asarray(images)
+        batch_at = _make_batcher(cfg.data.batch, tr_x)
+        init_x = tr_x[:1]
+    else:
+        s = max(cfg.model.image_size, 32)
+        tr_x = jnp.asarray(np.random.default_rng(cfg.train.seed).normal(
+            size=(cfg.data.batch, s, s, 3)), jnp.float32)
+        batch_at = lambda i: (tr_x,)
+        init_x = tr_x
     model = MODELS.build(cfg.model.name or "mae_vit_small_patch16",
                          dtype=jnp.float32, depth=2, decoder_depth=2)
     variables = model.init(
         {"params": jax.random.key(0), "masking": jax.random.key(1)},
-        x, train=False)
+        init_x, train=False)
 
     def loss_fn(p, i):
+        (bx,) = batch_at(i)
         loss, _, _ = model.apply(
-            {"params": p}, x, train=True,
+            {"params": p}, bx, train=True,
             rngs={"masking": jax.random.fold_in(jax.random.key(5), i),
                   "dropout": jax.random.fold_in(jax.random.key(6), i)})
         return loss
@@ -232,23 +257,47 @@ def run_supcon(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.ops import losses as L
 
-    s = cfg.model.image_size
     rng = np.random.default_rng(cfg.train.seed)
-    labels = np.repeat(np.arange(max(cfg.data.batch // 2, 1)), 2)
-    base = rng.normal(0, 0.2, (len(labels), s, s, 3)).astype(np.float32)
-    base[np.arange(len(labels)), labels * 3 % s, labels * 3 % s, :] += 2.0
-    x, y = jnp.asarray(base), jnp.asarray(labels)
+    if cfg.data.npz:
+        # real-data path: npz {images, labels}; the second view is a
+        # horizontal flip (two-view supervised-contrastive batches)
+        blob = np.load(cfg.data.npz)
+        images = _load_npz_images(blob)
+        labels_all = blob["labels"].astype(np.int32)
+        order = _pk_order(labels_all)   # mixed-class batches (negatives)
+        images, labels_all = images[order], labels_all[order]
+        tr_x = jnp.asarray(images)
+        tr_y = jnp.asarray(labels_all)
+        batch_at = _make_batcher(cfg.data.batch, tr_x, tr_y)
+        init_x = tr_x[:1]
+        two_views = lambda bx: (bx, bx[:, :, ::-1, :])
+    else:
+        s = cfg.model.image_size
+        labels = np.repeat(np.arange(max(cfg.data.batch // 2, 1)), 2)
+        base = rng.normal(0, 0.2,
+                          (len(labels), s, s, 3)).astype(np.float32)
+        base[np.arange(len(labels)), labels * 3 % s,
+             labels * 3 % s, :] += 2.0
+        tr_x, tr_y = jnp.asarray(base), jnp.asarray(labels)
+        batch_at = lambda i: (tr_x, tr_y)
+        init_x = tr_x[:1]
+        two_views = lambda bx: (bx, bx)     # two-view stand-in
 
     model = MODELS.build(cfg.model.name or "supcon_resnet18",
                          num_classes=cfg.model.num_classes,
                          dtype=jnp.float32)
-    variables = model.init(jax.random.key(0), x[:1], train=False)
+    variables = model.init(jax.random.key(0), init_x, train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
 
     def loss_fn(p, i):
-        z = model.apply({"params": p, "batch_stats": stats}, x, train=False)
-        feats = jnp.stack([z, z], axis=1)   # two-view stand-in
-        return L.supcon_loss(feats, y)
+        bx, by = batch_at(i)
+        va, vb = two_views(bx)
+        za = model.apply({"params": p, "batch_stats": stats}, va,
+                         train=False)
+        zb = model.apply({"params": p, "batch_stats": stats}, vb,
+                         train=False)
+        feats = jnp.stack([za, zb], axis=1)
+        return L.supcon_loss(feats, by)
 
     _, first, last = _loop(loss_fn, params, cfg.train.steps, cfg.train.lr)
     print(f"task_metric supcon_loss={last:.4f}")
@@ -270,19 +319,7 @@ def run_metric(cfg: TaskConfig) -> int:
         blob = np.load(cfg.data.npz)
         images = _load_npz_images(blob)
         labels_all = blob["labels"].astype(np.int32)
-        # PK-style order: K=2 same-id instances adjacent, ids cycling —
-        # every wraparound batch then has both positives AND negatives
-        # (a label-sorted order would give all-same-id batches: the
-        # triplet loss degenerates with no negatives)
-        by_id = np.argsort(labels_all, kind="stable")
-        within = np.zeros(len(labels_all), np.int64)
-        counts = {}
-        for pos, idx in enumerate(by_id):
-            c = int(labels_all[idx])
-            within[pos] = counts.get(c, 0)
-            counts[c] = counts.get(c, 0) + 1
-        order = by_id[np.lexsort((within % 2, labels_all[by_id],
-                                  within // 2))]
+        order = _pk_order(labels_all)
         images, labels_all = images[order], labels_all[order]
         n_id = int(labels_all.max()) + 1
         tr_x = jnp.asarray(images)
