@@ -465,12 +465,19 @@ def run_stereo(cfg: TaskConfig) -> int:
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.models.stereo.madnet import photometric_loss
 
-    s = max(cfg.model.image_size, 64)
     rng = np.random.default_rng(cfg.train.seed)
-    b = max(cfg.data.batch, 1)
-    left = rng.normal(0, 1, (b, s, s, 3)).astype(np.float32)
-    right = np.roll(left, -3, axis=2)
-    left, right = jnp.asarray(left), jnp.asarray(right)
+    if cfg.data.npz:
+        # real-data path: npz with left/right (N,H,W[,3]) rectified pairs
+        blob = np.load(cfg.data.npz)
+        left = _load_npz_images({"images": blob["left"]})
+        right = _load_npz_images({"images": blob["right"]})
+        left, right = jnp.asarray(left), jnp.asarray(right)
+    else:
+        s = max(cfg.model.image_size, 64)
+        b = max(cfg.data.batch, 1)
+        left = rng.normal(0, 1, (b, s, s, 3)).astype(np.float32)
+        right = np.roll(left, -3, axis=2)
+        left, right = jnp.asarray(left), jnp.asarray(right)
 
     model = MODELS.build(cfg.model.name or "madnet", dtype=jnp.float32)
     params = model.init(jax.random.key(0), left, right)["params"]
