@@ -1,0 +1,63 @@
+"""Real-data (npz) paths of the family task CLI: every --task accepts
+data.npz and trains a few steps on tiny fixture data (the bundled
+mini-dataset smoke idiom of the reference's per-project train.py)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _npz(tmp_path, **arrays):
+    path = str(tmp_path / "data.npz")
+    np.savez_compressed(path, **arrays)
+    return path
+
+
+def _images(n, size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((n, size, size)) * 255).astype(np.uint8)
+
+
+@pytest.mark.parametrize("task,make_arrays,extra", [
+    ("segmentation",
+     lambda: {"images": _images(12),
+              "masks": np.random.default_rng(1).integers(
+                  0, 3, (12, 32, 32)).astype(np.uint8)},
+     []),
+    ("keypoints",
+     lambda: {"images": _images(12, 64),
+              "keypoints": np.concatenate([
+                  np.random.default_rng(2).uniform(8, 56, (12, 3, 2)),
+                  np.ones((12, 3, 1))], -1).astype(np.float32)},
+     []),
+    ("metric",
+     lambda: {"images": _images(12),
+              "labels": np.arange(12, dtype=np.int32) % 3},
+     ["train.lr=1e-4"]),
+    ("mae",
+     lambda: {"images": _images(12)}, []),
+    ("supcon",
+     lambda: {"images": _images(12),
+              "labels": np.arange(12, dtype=np.int32) % 3},
+     []),
+    ("stereo",
+     lambda: {"left": _images(2, 64),
+              "right": np.roll(_images(2, 64), -3, axis=2)},
+     ["train.lr=1e-4"]),
+    ("stereo_online",
+     lambda: {"left": _images(3, 64),
+              "right": np.roll(_images(3, 64), -3, axis=2)},
+     ["train.lr=1e-4"]),
+])
+def test_task_trains_on_npz(task, make_arrays, extra, tmp_path, capsys):
+    from train_task import main
+    path = _npz(tmp_path, **make_arrays())
+    rc = main(["--task", task, f"data.npz={path}", "data.batch=4",
+               "train.steps=2"] + extra)
+    assert rc == 0
+    assert "task_metric" in capsys.readouterr().out

@@ -500,20 +500,32 @@ def run_stereo_online(cfg: TaskConfig) -> int:
     from deeplearning_tpu.models.stereo.madnet import (MADSampler,
                                                        photometric_loss)
 
-    s = max(cfg.model.image_size, 64)
     rng = np.random.default_rng(cfg.train.seed)
-    base = rng.normal(0, 1, (max(cfg.data.batch, 1), s, s, 3)).astype(
-        np.float32)
-    left = jnp.asarray(base)
-    right = jnp.asarray(np.roll(base, -3, axis=2))
+    if cfg.data.npz:
+        # real-data path: npz {left, right} frame sequences; online
+        # adaptation consumes frame i%N at step i (the video-stream
+        # semantics of Stereo_Online_Adaptation)
+        blob = np.load(cfg.data.npz)
+        lefts = jnp.asarray(_load_npz_images({"images": blob["left"]}))
+        rights = jnp.asarray(_load_npz_images({"images": blob["right"]}))
+        frame_at = lambda i: (lefts[i % lefts.shape[0]][None],
+                              rights[i % rights.shape[0]][None])
+        left0, right0 = frame_at(0)
+    else:
+        s = max(cfg.model.image_size, 64)
+        base = rng.normal(0, 1, (max(cfg.data.batch, 1), s, s, 3)).astype(
+            np.float32)
+        left0 = jnp.asarray(base)
+        right0 = jnp.asarray(np.roll(base, -3, axis=2))
+        frame_at = lambda i: (left0, right0)
 
     model = MODELS.build(cfg.model.name or "madnet", dtype=jnp.float32)
-    params = model.init(jax.random.key(0), left, right)["params"]
+    params = model.init(jax.random.key(0), left0, right0)["params"]
     tx = optax.adam(cfg.train.lr)
     opt = tx.init(params)
 
     @jax.jit
-    def step(params, opt, mask):
+    def step(params, opt, mask, left, right):
         def lf(p):
             out = model.apply({"params": p}, left, right)
             return photometric_loss(left, right, out["disparity"])
@@ -531,7 +543,8 @@ def run_stereo_online(cfg: TaskConfig) -> int:
     for i in range(cfg.train.steps):
         selected = sampler.sample()
         mask = sampler.grad_mask(params, selected)
-        params, opt, loss = step(params, opt, mask)
+        fl, fr = frame_at(i)
+        params, opt, loss = step(params, opt, mask, fl, fr)
         last = float(loss)
         sampler.update(selected, last)
         if first is None:
