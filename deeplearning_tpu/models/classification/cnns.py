@@ -14,6 +14,7 @@ from typing import Any, Dict, Sequence, Tuple, Union
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 
 VGG_CFGS: Dict[str, Sequence[Union[int, str]]] = {
@@ -105,7 +106,8 @@ class GoogLeNet(nn.Module):
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, dtype=self.dtype, padding="SAME")
         x = x.astype(self.dtype)
-        x = nn.relu(conv(64, (7, 7), strides=(2, 2), name="conv1")(x))
+        x = nn.relu(conv(64, (7, 7), strides=(2, 2),
+                         padding=torch_pad(7), name="conv1")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         x = nn.relu(conv(64, (1, 1), name="conv2")(x))
         x = nn.relu(conv(192, (3, 3), name="conv3")(x))

@@ -32,7 +32,7 @@ class ConvNeXtBlock(nn.Module):
                     name="dwconv")(x)
         y = nn.LayerNorm(dtype=self.dtype, name="norm")(y)
         y = nn.Dense(4 * self.dim, dtype=self.dtype, name="pw1")(y)
-        y = nn.gelu(y, approximate=True)
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.dim, dtype=self.dtype, name="pw2")(y)
         gamma = self.param("gamma",
                            nn.initializers.constant(self.layer_scale_init),
@@ -94,7 +94,7 @@ class CoAtNet(nn.Module):
                         name=f"stem{i}")(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              dtype=self.dtype, name=f"stem{i}_bn")(x)
-            x = nn.gelu(x, approximate=True)
+            x = nn.gelu(x, approximate=False)
         # s1, s2: MBConv
         for si in (1, 2):
             for i in range(self.depths[si]):
@@ -119,7 +119,7 @@ class CoAtNet(nn.Module):
                                  name=f"s{si}_b{i}_norm2")(x)
                 y = nn.Dense(4 * self.dims[si], dtype=self.dtype,
                              name=f"s{si}_b{i}_mlp1")(y)
-                y = nn.gelu(y, approximate=True)
+                y = nn.gelu(y, approximate=False)
                 y = nn.Dense(self.dims[si], dtype=self.dtype,
                              name=f"s{si}_b{i}_mlp2")(y)
                 x = x + y

@@ -40,7 +40,8 @@ class ShuffleV2Block(nn.Module):
             x1, x2 = jnp.split(x, 2, axis=-1)
         else:
             # spatial-down branch processes the whole input
-            x1 = nn.Conv(x.shape[-1], (3, 3), strides=(2, 2), padding="SAME",
+            x1 = nn.Conv(x.shape[-1], (3, 3), strides=(2, 2),
+                         padding=[(1, 1), (1, 1)],
                          feature_group_count=x.shape[-1], use_bias=False,
                          dtype=self.dtype, name="proj_dw")(x)
             x1 = norm(name="proj_dw_bn")(x1)
@@ -52,7 +53,7 @@ class ShuffleV2Block(nn.Module):
                     name="pw1")(x2)
         y = nn.relu(norm(name="pw1_bn")(y))
         y = nn.Conv(branch, (3, 3), strides=(self.stride,) * 2,
-                    padding="SAME", feature_group_count=branch,
+                    padding=[(1, 1), (1, 1)], feature_group_count=branch,
                     use_bias=False, dtype=self.dtype, name="dw")(y)
         y = norm(name="dw_bn")(y)
         y = nn.Conv(branch, (1, 1), use_bias=False, dtype=self.dtype,
@@ -70,12 +71,12 @@ class ShuffleNetV2(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(24, (3, 3), strides=(2, 2), padding="SAME",
+        x = nn.Conv(24, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype, name="stem")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for si, (reps, ch) in enumerate(zip(self.stage_repeats,
                                             self.stage_channels)):
             for i in range(reps):
@@ -110,8 +111,10 @@ class InvertedResidual(nn.Module):
                         name="expand")(y)
             y = nn.silu(norm(name="expand_bn")(y)) if self.use_se else \
                 nn.relu6(norm(name="expand_bn")(y))
+        pad = self.kernel // 2
         y = nn.Conv(hidden, (self.kernel,) * 2, strides=(self.stride,) * 2,
-                    padding="SAME", feature_group_count=hidden,
+                    padding=[(pad, pad), (pad, pad)],
+                    feature_group_count=hidden,
                     use_bias=False, dtype=self.dtype, name="dw")(y)
         y = nn.silu(norm(name="dw_bn")(y)) if self.use_se else \
             nn.relu6(norm(name="dw_bn")(y))
@@ -142,7 +145,8 @@ class MobileNetV2(nn.Module):
         def c(ch):
             return max(8, int(ch * self.width_mult + 4) // 8 * 8)
         x = x.astype(self.dtype)
-        x = nn.Conv(c(32), (3, 3), strides=(2, 2), padding="SAME",
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2),
+                    padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype, name="stem")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="stem_bn")(x)
@@ -196,7 +200,8 @@ class EfficientNet(nn.Module):
         def d(reps):
             return int(math.ceil(reps * self.depth_coef))
         x = x.astype(self.dtype)
-        x = nn.Conv(c(32), (3, 3), strides=(2, 2), padding="SAME",
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2),
+                    padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype, name="stem")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="stem_bn")(x)

@@ -59,7 +59,8 @@ class SKConv(nn.Module):
         branches = []
         for i, dil in enumerate((1, 2)):
             b = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
-                        kernel_dilation=(dil, dil), padding="SAME",
+                        kernel_dilation=(dil, dil),
+                        padding=[(dil, dil), (dil, dil)],
                         use_bias=False, dtype=self.dtype,
                         name=f"branch{i}")(x)
             b = self.norm(name=f"bn{i}")(b)
@@ -91,8 +92,8 @@ class SplitAttention(nn.Module):
     def __call__(self, x):
         r = self.radix
         u = nn.Conv(self.features * r, (3, 3), strides=(self.stride,) * 2,
-                    padding="SAME", feature_group_count=r, use_bias=False,
-                    dtype=self.dtype, name="conv")(x)
+                    padding=[(1, 1), (1, 1)], feature_group_count=r,
+                    use_bias=False, dtype=self.dtype, name="conv")(x)
         u = self.norm(name="bn")(u)
         u = nn.relu(u)
         b = u.shape[0]
@@ -119,9 +120,12 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
+        # explicit symmetric padding: identical to SAME at stride 1 but
+        # matches torch's pad=1 semantics at stride 2 (SAME pads (0,1)
+        # there, sampling shifted centers — breaks weight-port parity)
         y = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
-                    padding="SAME", use_bias=False, dtype=self.dtype,
-                    name="conv1")(x)
+                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
@@ -163,7 +167,8 @@ class Bottleneck(nn.Module):
                                dtype=self.dtype, name="splat")(y)
         else:
             y = nn.Conv(width, (3, 3), strides=(self.stride,) * 2,
-                        padding="SAME", feature_group_count=self.groups,
+                        padding=[(1, 1), (1, 1)],
+                        feature_group_count=self.groups,
                         use_bias=False, dtype=self.dtype, name="conv2")(y)
             y = self.norm(name="bn2")(y)
             y = nn.relu(y)

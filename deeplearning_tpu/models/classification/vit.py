@@ -116,7 +116,9 @@ class Mlp(nn.Module):
         c = x.shape[-1]
         x = nn.Dense(int(c * self.hidden_ratio), dtype=self.dtype,
                      name="fc1")(x)
-        x = nn.gelu(x, approximate=True)
+        # exact erf GELU — matches torch nn.GELU() (vit_model.py:114); on
+        # TPU the elementwise op fuses either way, so exactness is free
+        x = nn.gelu(x, approximate=False)
         x = nn.Dropout(self.drop, deterministic=deterministic)(x)
         x = nn.Dense(c, dtype=self.dtype, name="fc2")(x)
         x = nn.Dropout(self.drop, deterministic=deterministic)(x)

@@ -15,6 +15,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops.padding import torch_pad
+
 
 class FPN(nn.Module):
     out_channels: int = 256
@@ -45,10 +47,10 @@ class FPN(nn.Module):
                 out[f"p{top}"], (1, 1), strides=(2, 2))
         elif self.extra_levels == "p6p7":
             p6 = nn.Conv(self.out_channels, (3, 3), strides=(2, 2),
-                         padding="SAME", dtype=self.dtype,
+                         padding=torch_pad(3), dtype=self.dtype,
                          name="p6")(feats[names[-1]])
             p7 = nn.Conv(self.out_channels, (3, 3), strides=(2, 2),
-                         padding="SAME", dtype=self.dtype,
+                         padding=torch_pad(3), dtype=self.dtype,
                          name="p7")(nn.relu(p6))
             out[f"p{top + 1}"] = p6
             out[f"p{top + 2}"] = p7

@@ -44,8 +44,12 @@ class ConvBnSiLU(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        # symmetric k//2 padding = torch autopad (yolov5 common.py autopad);
+        # SAME would pad (0,1) at stride 2 and shift sampling centers
+        pad = self.kernel // 2
         x = nn.Conv(self.features, (self.kernel,) * 2,
-                    strides=(self.stride,) * 2, padding="SAME",
+                    strides=(self.stride,) * 2,
+                    padding=[(pad, pad), (pad, pad)],
                     feature_group_count=self.groups, use_bias=False,
                     dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.97,

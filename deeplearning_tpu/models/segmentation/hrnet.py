@@ -27,8 +27,12 @@ class ConvBN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        # symmetric k//2 padding (torch semantics; SAME pads (0,1) at
+        # stride 2 which shifts sampling centers vs the reference)
+        pad = self.kernel // 2
         x = nn.Conv(self.features, (self.kernel,) * 2,
-                    strides=(self.stride,) * 2, padding="SAME",
+                    strides=(self.stride,) * 2,
+                    padding=[(pad, pad), (pad, pad)],
                     use_bias=False, dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="bn")(x)

@@ -64,6 +64,8 @@ class PyramidTower(nn.Module):
     def __call__(self, x):
         feats = []
         for i, wdt in enumerate(self.widths):
+            # SAME (TF semantics) is correct here: the reference MadNet is
+            # a TF port whose conv_with_same_pad.py reimplements TF SAME
             x = nn.Conv(wdt, (3, 3), strides=(2, 2), padding="SAME",
                         dtype=self.dtype, name=f"conv{i}a")(x)
             x = nn.leaky_relu(x, 0.2)

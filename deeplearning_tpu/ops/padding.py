@@ -1,0 +1,22 @@
+"""Torch-semantics conv padding.
+
+torch's Conv2d(k, s, p=k//2) pads symmetrically; XLA's "SAME" pads
+asymmetrically ((0,1) at stride 2 for k=3), which shifts sampling centers
+and breaks weight-port parity with the reference models (see
+tests/test_reference_parity.py). Use ``torch_pad(k)`` for any conv whose
+reference counterpart is a torch Conv2d with p=k//2 — identical to SAME at
+stride 1 (odd k), torch-correct at stride 2.
+
+(The MadNet family is the exception: its reference reimplements TF SAME,
+so those convs keep padding="SAME".)
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def torch_pad(kernel: int, dilation: int = 1) -> List[Tuple[int, int]]:
+    """Explicit symmetric padding equal to torch's p = dilation*(k-1)//2."""
+    p = dilation * (kernel - 1) // 2
+    return [(p, p), (p, p)]

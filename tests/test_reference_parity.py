@@ -1,0 +1,344 @@
+"""Numerical forward parity against the ACTUAL reference models.
+
+Imports the reference repo's own PyTorch modules (CPU), ports their
+randomly-initialised weights through utils/torch_import.py, and asserts
+the Flax forward matches to ~1e-4 in f32. This is the strongest offline
+correctness check available: it validates layer semantics (padding,
+norm eps, GELU flavor, window/shift arithmetic, relative-position bias
+indexing) end to end, not just our own self-consistency.
+
+Covered reference surfaces:
+- classification/vision_transformer/vit_model.py:164  VisionTransformer
+- classification/resnet/models/networks.py            resnet18/resnet50
+- classification/swin_transformer/models/swin_transformer.py:70
+- detection/yolov5/models/common.py                   Focus/Conv/C3/SPP
+- deep_stereo/.../models/MadNet.py                    Pyramid_Encoder
+"""
+
+import contextlib
+import importlib.util
+import re
+import sys
+import types
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning_tpu.utils.torch_import import torch_to_flax
+
+REF = Path("/root/reference")
+
+pytestmark = pytest.mark.skipif(not REF.exists(),
+                                reason="reference repo not present")
+
+
+# ---------------------------------------------------------------- helpers
+
+@contextlib.contextmanager
+def _isolated_imports(extra_sys_path=(), stubs=None):
+    """Import reference projects without leaking their top-level module
+    names (utils/models/data_utils) into the test process."""
+    saved_modules = sys.modules.copy()
+    saved_path = list(sys.path)
+    try:
+        sys.path[:0] = [str(p) for p in extra_sys_path]
+        if stubs:
+            sys.modules.update(stubs)
+        yield
+    finally:
+        sys.modules.clear()
+        sys.modules.update(saved_modules)
+        sys.path[:] = saved_path
+
+
+def _load_by_path(name, path):
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _timm_stub():
+    timm = types.ModuleType("timm")
+    models_m = types.ModuleType("timm.models")
+    layers_m = types.ModuleType("timm.models.layers")
+
+    class DropPath(torch.nn.Module):      # identity in eval mode
+        def __init__(self, drop_prob=0.0):
+            super().__init__()
+            self.drop_prob = drop_prob
+
+        def forward(self, x):
+            return x
+
+    layers_m.DropPath = DropPath
+    layers_m.to_2tuple = lambda v: v if isinstance(v, tuple) else (v, v)
+    layers_m.trunc_normal_ = torch.nn.init.trunc_normal_
+    timm.models = models_m
+    models_m.layers = layers_m
+    return {"timm": timm, "timm.models": models_m,
+            "timm.models.layers": layers_m}
+
+
+def _dummy_module(name, attrs):
+    mod = types.ModuleType(name)
+    for a in attrs:
+        setattr(mod, a, lambda *args, **kw: None)
+    return mod
+
+
+def _randomize_torch(net, seed=0):
+    """Non-trivial weights AND running stats so eval-mode BN is exercised."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in net.modules():
+            if isinstance(m, (torch.nn.BatchNorm2d, torch.nn.BatchNorm1d)):
+                m.running_mean.normal_(0.0, 0.5, generator=g)
+                m.running_var.uniform_(0.5, 2.0, generator=g)
+                m.weight.normal_(1.0, 0.2, generator=g)
+                m.bias.normal_(0.0, 0.2, generator=g)
+            elif isinstance(m, torch.nn.Linear):
+                m.weight.normal_(0.0, 0.05, generator=g)
+                if m.bias is not None:
+                    m.bias.normal_(0.0, 0.02, generator=g)
+            elif isinstance(m, torch.nn.Conv2d):
+                m.weight.normal_(0.0, 0.05, generator=g)
+                if m.bias is not None:
+                    m.bias.normal_(0.0, 0.02, generator=g)
+    return net.eval()
+
+
+def _port(net, rename, drop_suffixes=("relative_position_index",
+                                      "attn_mask")):
+    sd = {k: v for k, v in net.state_dict().items()
+          if not k.endswith(drop_suffixes)}
+    variables = torch_to_flax(sd, rename=rename)
+    return jax.tree_util.tree_map(jnp.asarray, variables)
+
+
+def _nchw(x):
+    return torch.from_numpy(x.transpose(0, 3, 1, 2).copy())
+
+
+def _assert_close(got, want, tol=1e-4):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+# ------------------------------------------------------------------- ViT
+
+def test_vit_forward_parity():
+    with _isolated_imports():
+        ref = _load_by_path(
+            "ref_vit_model",
+            REF / "classification/vision_transformer/vit_model.py")
+        torch.manual_seed(0)
+        net = ref.VisionTransformer(
+            img_size=64, patch_size=16, num_classes=10, embed_dim=64,
+            depth=3, num_heads=4, representation_size=32)
+        _randomize_torch(net)
+        with torch.no_grad():
+            net.pos_embed.normal_(0.0, 0.02)
+            net.cls_token.normal_(0.0, 0.02)
+        x = np.random.default_rng(0).normal(
+            size=(2, 64, 64, 3)).astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy()
+
+    def rename(stem):
+        return re.sub(r"blocks\.(\d+)", r"blocks_\1", stem) \
+            .replace("pre_logits.fc", "pre_logits")
+
+    variables = _port(net, rename)
+    from deeplearning_tpu.models.classification.vit import VisionTransformer
+    model = VisionTransformer(
+        img_size=64, patch_size=16, num_classes=10, embed_dim=64, depth=3,
+        num_heads=4, representation_size=32, dtype=jnp.float32)
+    got = model.apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
+
+
+# ---------------------------------------------------------------- ResNet
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+def test_resnet_forward_parity(arch):
+    with _isolated_imports():
+        ref = _load_by_path(
+            "ref_resnet_networks",
+            REF / "classification/resnet/models/networks.py")
+        torch.manual_seed(0)
+        net = getattr(ref, arch)(num_classes=10)
+        _randomize_torch(net)
+        x = np.random.default_rng(1).normal(
+            size=(2, 64, 64, 3)).astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy()
+
+    def rename(stem):
+        stem = re.sub(r"layer(\d+)\.(\d+)", r"layer\1_block\2", stem)
+        stem = stem.replace("downsample.0", "downsample_conv")
+        stem = stem.replace("downsample.1", "downsample_bn")
+        return stem
+
+    variables = _port(net, rename)
+    from deeplearning_tpu.core.registry import MODELS
+    model = MODELS.build(arch, num_classes=10, dtype=jnp.float32)
+    got = model.apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
+
+
+# ------------------------------------------------------------------ Swin
+
+def test_swin_forward_parity():
+    swin_dir = REF / "classification/swin_transformer/models"
+    with _isolated_imports(stubs=_timm_stub()):
+        ref = _load_by_path("ref_swin", swin_dir / "swin_transformer.py")
+        torch.manual_seed(0)
+        net = ref.SwinTransformer(
+            img_size=32, patch_size=2, num_classes=10, embed_dim=16,
+            depths=[2, 2], num_heads=[2, 4], window_size=4,
+            drop_path_rate=0.0, ape=False, patch_norm=True)
+        _randomize_torch(net)
+        with torch.no_grad():
+            for k, v in net.state_dict().items():
+                if k.endswith("relative_position_bias_table"):
+                    v.normal_(0.0, 0.05)
+        x = np.random.default_rng(2).normal(
+            size=(2, 32, 32, 3)).astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy()
+
+    def rename(stem):
+        stem = stem.replace("patch_embed.proj", "patch_embed")
+        stem = stem.replace("patch_embed.norm", "patch_norm")
+        stem = re.sub(r"layers\.(\d+)\.blocks\.(\d+)",
+                      r"stage\1_block\2", stem)
+        stem = re.sub(r"layers\.(\d+)\.downsample", r"stage\1_merge", stem)
+        return stem
+
+    variables = _port(net, rename)
+    from deeplearning_tpu.models.classification.swin import SwinTransformer
+    model = SwinTransformer(
+        patch_size=2, num_classes=10, embed_dim=16, depths=(2, 2),
+        num_heads=(2, 4), window=4, drop_path_rate=0.0, dtype=jnp.float32)
+    got = model.apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
+
+
+# -------------------------------------------------------- yolov5 blocks
+
+def test_yolov5_blocks_parity():
+    """Focus → Conv(s2) → C3(n=2) → SPP chain vs our ConvBnSiLU/CSPLayer/
+    SPPBottleneck (detection/yolov5/models/common.py blocks)."""
+    y5 = REF / "detection/yolov5"
+    stubs = {
+        "utils": types.ModuleType("utils"),
+        "utils.datasets": _dummy_module(
+            "utils.datasets", ["exif_transpose", "letterbox"]),
+        "utils.general": _dummy_module(
+            "utils.general",
+            ["non_max_suppression", "make_divisible", "scale_coords",
+             "increment_path", "xyxy2xywh", "save_one_box"]),
+        "utils.plots": _dummy_module(
+            "utils.plots", ["colors", "plot_one_box"]),
+        "utils.torch_utils": _dummy_module(
+            "utils.torch_utils", ["time_sync"]),
+    }
+    with _isolated_imports(stubs=stubs):
+        common = _load_by_path("ref_y5_common", y5 / "models/common.py")
+        torch.manual_seed(0)
+        net = torch.nn.Sequential()
+        net.add_module("focus", common.Focus(3, 16, k=3))
+        net.add_module("conv", common.Conv(16, 32, 3, 2))
+        net.add_module("c3", common.C3(32, 32, n=2))
+        net.add_module("spp", common.SPP(32, 32))
+        _randomize_torch(net)
+        # yolov5's initialize_weights (utils/torch_utils.py) sets BN
+        # eps=1e-3 on every model it trains; our ConvBnSiLU matches that,
+        # not the raw nn.BatchNorm2d default of 1e-5
+        for m in net.modules():
+            if isinstance(m, torch.nn.BatchNorm2d):
+                m.eps = 1e-3
+        x = np.random.default_rng(3).normal(
+            size=(2, 32, 32, 3)).astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy().transpose(0, 2, 3, 1)
+
+    import flax.linen as nn
+    from deeplearning_tpu.models.detection.yolox import (
+        ConvBnSiLU, CSPLayer, SPPBottleneck)
+
+    class Chain(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            patches = jnp.concatenate([
+                x[:, 0::2, 0::2], x[:, 1::2, 0::2],
+                x[:, 0::2, 1::2], x[:, 1::2, 1::2]], axis=-1)
+            y = ConvBnSiLU(16, 3, dtype=jnp.float32, name="focus")(
+                patches, train)
+            y = ConvBnSiLU(32, 3, 2, dtype=jnp.float32, name="conv")(
+                y, train)
+            y = CSPLayer(32, 2, dtype=jnp.float32, name="c3")(y, train)
+            return SPPBottleneck(32, dtype=jnp.float32, name="spp")(
+                y, train)
+
+    def rename(stem):
+        stem = stem.replace("focus.conv.conv", "focus.conv")
+        stem = stem.replace("focus.conv.bn", "focus.bn")
+        stem = re.sub(r"c3\.m\.(\d+)\.cv1", r"c3.b\1.c1", stem)
+        stem = re.sub(r"c3\.m\.(\d+)\.cv2", r"c3.b\1.c2", stem)
+        stem = stem.replace("c3.cv1", "c3.main")
+        stem = stem.replace("c3.cv2", "c3.skip")
+        stem = stem.replace("c3.cv3", "c3.out")
+        stem = stem.replace("spp.cv1", "spp.pre")
+        stem = stem.replace("spp.cv2", "spp.post")
+        return stem
+
+    variables = _port(net, rename)
+    got = Chain().apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
+
+
+# --------------------------------------------------------- MADNet tower
+
+def test_madnet_pyramid_parity():
+    proj = REF / "deep_stereo/Real_time_self_adaptive_depp_stereo"
+    # torchvision isn't installed; data_utils/preprocessing.py imports it
+    # at module scope but Pyramid_Encoder never calls into it
+    tv = types.ModuleType("torchvision")
+    tv.transforms = types.ModuleType("torchvision.transforms")
+    stubs = {"torchvision": tv,
+             "torchvision.transforms": tv.transforms}
+    with _isolated_imports(extra_sys_path=[proj], stubs=stubs):
+        madnet_mod = importlib.import_module("models.MadNet")
+        torch.manual_seed(0)
+        net = madnet_mod.Pyramid_Encoder(input_channel=3)
+        _randomize_torch(net)
+        x = np.random.default_rng(4).normal(
+            size=(1, 64, 64, 3)).astype("f4")
+        with torch.no_grad():
+            feats = net(_nchw(x))
+        want = [feats[f"f{i}"].numpy().transpose(0, 2, 3, 1)
+                for i in range(1, 7)]
+
+    def rename(stem):
+        m = re.fullmatch(r"conv(\d+)\.0", stem)
+        if m is None:
+            return None
+        n = int(m.group(1))
+        level, ab = (n - 1) // 2, "a" if n % 2 == 1 else "b"
+        return f"conv{level}{ab}"
+
+    variables = _port(net, rename)
+    from deeplearning_tpu.models.stereo.madnet import PyramidTower
+    got = PyramidTower(dtype=jnp.float32).apply(variables, jnp.asarray(x))
+    assert len(got) == 6
+    for g, w in zip(got, want):
+        _assert_close(g, w)
