@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 from .mobile import InvertedResidual
 from .vit import Attention, DropPath
@@ -90,7 +91,7 @@ class CoAtNet(nn.Module):
         for i in range(self.depths[0]):
             x = nn.Conv(self.dims[0], (3, 3),
                         strides=(2, 2) if i == 0 else (1, 1),
-                        padding="SAME", dtype=self.dtype,
+                        padding=torch_pad(3), dtype=self.dtype,
                         name=f"stem{i}")(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              dtype=self.dtype, name=f"stem{i}_bn")(x)

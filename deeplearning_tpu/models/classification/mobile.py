@@ -15,6 +15,7 @@ from typing import Any, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 from .resnet import SEModule
 
@@ -111,9 +112,8 @@ class InvertedResidual(nn.Module):
                         name="expand")(y)
             y = nn.silu(norm(name="expand_bn")(y)) if self.use_se else \
                 nn.relu6(norm(name="expand_bn")(y))
-        pad = self.kernel // 2
         y = nn.Conv(hidden, (self.kernel,) * 2, strides=(self.stride,) * 2,
-                    padding=[(pad, pad), (pad, pad)],
+                    padding=torch_pad(self.kernel),
                     feature_group_count=hidden,
                     use_bias=False, dtype=self.dtype, name="dw")(y)
         y = nn.silu(norm(name="dw_bn")(y)) if self.use_se else \

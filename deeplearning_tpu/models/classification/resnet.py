@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 
 ModuleDef = Any
@@ -60,7 +61,7 @@ class SKConv(nn.Module):
         for i, dil in enumerate((1, 2)):
             b = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
                         kernel_dilation=(dil, dil),
-                        padding=[(dil, dil), (dil, dil)],
+                        padding=torch_pad(3, dil),
                         use_bias=False, dtype=self.dtype,
                         name=f"branch{i}")(x)
             b = self.norm(name=f"bn{i}")(b)
@@ -92,7 +93,7 @@ class SplitAttention(nn.Module):
     def __call__(self, x):
         r = self.radix
         u = nn.Conv(self.features * r, (3, 3), strides=(self.stride,) * 2,
-                    padding=[(1, 1), (1, 1)], feature_group_count=r,
+                    padding=torch_pad(3), feature_group_count=r,
                     use_bias=False, dtype=self.dtype, name="conv")(x)
         u = self.norm(name="bn")(u)
         u = nn.relu(u)
@@ -124,7 +125,7 @@ class BasicBlock(nn.Module):
         # matches torch's pad=1 semantics at stride 2 (SAME pads (0,1)
         # there, sampling shifted centers — breaks weight-port parity)
         y = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
-                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    padding=torch_pad(3), use_bias=False,
                     dtype=self.dtype, name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
@@ -167,7 +168,7 @@ class Bottleneck(nn.Module):
                                dtype=self.dtype, name="splat")(y)
         else:
             y = nn.Conv(width, (3, 3), strides=(self.stride,) * 2,
-                        padding=[(1, 1), (1, 1)],
+                        padding=torch_pad(3),
                         feature_group_count=self.groups,
                         use_bias=False, dtype=self.dtype, name="conv2")(y)
             y = self.norm(name="bn2")(y)
@@ -194,10 +195,19 @@ class ResNet(nn.Module):
     attention: Optional[str] = None
     dtype: Any = jnp.bfloat16
     return_features: bool = False   # backbone mode for detection/seg FPNs
+    frozen_bn: bool = False         # FrozenBatchNorm2d semantics
+                                    # (fasterRcnn/models/backbone/
+                                    # resnet50_fpn.py:5): statistics stay
+                                    # fixed even in train mode, so
+                                    # small-batch detection fine-tuning
+                                    # matches the reference. Freeze the
+                                    # scale/bias grads via the optimizer
+                                    # freeze mask (train/optim.py).
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        norm = partial(nn.BatchNorm,
+                       use_running_average=(not train) or self.frozen_bn,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],

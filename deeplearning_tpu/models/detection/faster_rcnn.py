@@ -97,12 +97,16 @@ class FasterRCNN(nn.Module):
     anchors_per_loc: int = 3
     roi_output_size: int = 7
     dtype: Any = jnp.bfloat16
+    backbone_frozen_bn: bool = False   # FrozenBatchNorm2d backbone stats
+                                       # (resnet50_fpn.py:5); set True when
+                                       # fine-tuning from ported weights
 
     @nn.compact
     def __call__(self, images: jax.Array, proposals: Optional[jax.Array]
                  = None, train: bool = False) -> Dict[str, Any]:
         feats = ResNet(stage_sizes=self.backbone_sizes,
                        return_features=True, dtype=self.dtype,
+                       frozen_bn=self.backbone_frozen_bn,
                        name="backbone")(images, train=train)
         pyramid = FPN(self.fpn_channels, extra_levels="pool",
                       dtype=self.dtype, name="fpn")(feats)

@@ -61,6 +61,8 @@ class RetinaNet(nn.Module):
     anchors_per_loc: int = 9
     fpn_channels: int = 256
     dtype: Any = jnp.bfloat16
+    backbone_frozen_bn: bool = False   # FrozenBatchNorm2d backbone stats
+                                       # (resnet50_fpn.py:5)
 
     @nn.compact
     def __call__(self, images: jax.Array, train: bool = False
@@ -68,6 +70,7 @@ class RetinaNet(nn.Module):
         from .fpn import FPN
         backbone = ResNet(stage_sizes=self.backbone_sizes,
                           return_features=True, dtype=self.dtype,
+                          frozen_bn=self.backbone_frozen_bn,
                           name="backbone")
         feats = backbone(images, train=train)
         feats = {k: v for k, v in feats.items() if k in ("c3", "c4", "c5")}

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 from ...ops import boxes as box_ops
 from ...ops import losses as L
@@ -44,12 +45,11 @@ class ConvBnSiLU(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        # symmetric k//2 padding = torch autopad (yolov5 common.py autopad);
-        # SAME would pad (0,1) at stride 2 and shift sampling centers
-        pad = self.kernel // 2
+        # torch autopad semantics (yolov5 common.py autopad); SAME would
+        # pad (0,1) at stride 2 and shift sampling centers
         x = nn.Conv(self.features, (self.kernel,) * 2,
                     strides=(self.stride,) * 2,
-                    padding=[(pad, pad), (pad, pad)],
+                    padding=torch_pad(self.kernel),
                     feature_group_count=self.groups, use_bias=False,
                     dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.97,

@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 
 
@@ -27,12 +28,11 @@ class ConvBN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        # symmetric k//2 padding (torch semantics; SAME pads (0,1) at
-        # stride 2 which shifts sampling centers vs the reference)
-        pad = self.kernel // 2
+        # torch padding semantics (SAME pads (0,1) at stride 2, which
+        # shifts sampling centers vs the reference)
         x = nn.Conv(self.features, (self.kernel,) * 2,
                     strides=(self.stride,) * 2,
-                    padding=[(pad, pad), (pad, pad)],
+                    padding=torch_pad(self.kernel),
                     use_bias=False, dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="bn")(x)

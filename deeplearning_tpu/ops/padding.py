@@ -1,6 +1,7 @@
 """Torch-semantics conv padding.
 
-torch's Conv2d(k, s, p=k//2) pads symmetrically; XLA's "SAME" pads
+torch's Conv2d(k, s, p=(k-1)//2) pads symmetrically (== k//2 for the odd
+kernels torch models use); XLA's "SAME" pads
 asymmetrically ((0,1) at stride 2 for k=3), which shifts sampling centers
 and breaks weight-port parity with the reference models (see
 tests/test_reference_parity.py). Use ``torch_pad(k)`` for any conv whose

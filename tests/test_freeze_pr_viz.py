@@ -112,3 +112,54 @@ class TestEmbeddingProjection:
         labels = [0] * 20 + [1] * 20
         assert embedding_projection_figure(emb, labels, "pca") is not None
         assert embedding_projection_figure(emb, labels, "tsne") is not None
+
+
+class TestFrozenBN:
+    """FrozenBatchNorm2d semantics (fasterRcnn/models/backbone/
+    resnet50_fpn.py:5): batch statistics stay fixed in train mode, so the
+    train-mode forward equals the eval-mode forward and batch_stats never
+    update. Pairs with the optimizer freeze mask for full requires_grad
+    =False parity."""
+
+    def _model_and_vars(self, frozen):
+        from deeplearning_tpu.core.registry import MODELS
+        model = MODELS.build("retinanet_resnet18_fpn", num_classes=3,
+                             backbone_frozen_bn=frozen)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 64, 64, 3)), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        # non-trivial running stats so frozen vs live actually differs
+        keys = iter(jax.random.split(jax.random.key(1), 10_000))
+        stats = jax.tree.map(
+            lambda s: s + 0.3 * jax.random.uniform(next(keys), s.shape),
+            variables["batch_stats"])
+        return model, {"params": variables["params"],
+                       "batch_stats": stats}, x
+
+    def test_frozen_stats_do_not_update(self):
+        model, variables, x = self._model_and_vars(frozen=True)
+        _, mutated = model.apply(variables, x, train=True,
+                                 mutable=["batch_stats"])
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(mutated["batch_stats"])
+        assert all(bool(jnp.array_equal(b, a))
+                   for b, a in zip(before, after))
+
+    def test_frozen_train_forward_equals_eval(self):
+        model, variables, x = self._model_and_vars(frozen=True)
+        train_out, _ = model.apply(variables, x, train=True,
+                                   mutable=["batch_stats"])
+        eval_out = model.apply(variables, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(train_out["cls_logits"]),
+            np.asarray(eval_out["cls_logits"]),
+            rtol=1e-6, atol=1e-6)
+
+    def test_live_bn_still_updates(self):
+        model, variables, x = self._model_and_vars(frozen=False)
+        _, mutated = model.apply(variables, x, train=True,
+                                 mutable=["batch_stats"])
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(mutated["batch_stats"])
+        assert any(not bool(jnp.array_equal(b, a))
+                   for b, a in zip(before, after))

@@ -41,6 +41,10 @@ class DetModelCfg:
     name: str = "retinanet_resnet18_fpn"
     num_classes: int = 3
     image_size: int = 128
+    backbone_frozen_bn: bool = False  # FrozenBatchNorm2d backbone stats
+                                      # (fasterRcnn resnet50_fpn.py:5);
+                                      # pair with train.freeze=backbone
+                                      # for reference fine-tune semantics
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +64,8 @@ class DetTrainCfg:
     steps: int = 100
     lr: float = 1e-3
     clip_grad_norm: float = 1.0
+    freeze: str = ""                  # comma-separated param-path patterns
+                                      # (e.g. "backbone"), yolov5 --freeze
     seed: int = 0
     eval_score_thresh: float = 0.3
     eval_tta: bool = False            # ALSO eval with multi-scale+flip
@@ -349,7 +355,11 @@ def run(cfg) -> dict:
 
     model_classes = num_classes + (
         1 if cfg.model.name.startswith("fasterrcnn") else 0)  # +background
-    model = MODELS.build(cfg.model.name, num_classes=model_classes)
+    model_kw = {}
+    if cfg.model.backbone_frozen_bn:
+        model_kw["backbone_frozen_bn"] = True
+    model = MODELS.build(cfg.model.name, num_classes=model_classes,
+                         **model_kw)
     loss_fn_task, predict_fn = build_task(model, cfg.model.name,
                                           num_classes,
                                           cfg.train.eval_score_thresh,
@@ -357,8 +367,12 @@ def run(cfg) -> dict:
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
-    tx = optax.chain(optax.clip_by_global_norm(cfg.train.clip_grad_norm),
-                     optax.adam(cfg.train.lr))
+    from deeplearning_tpu.train.optim import build_optimizer
+    tx = build_optimizer(
+        "adam", cfg.train.lr, clip_grad_norm=cfg.train.clip_grad_norm,
+        params=params,
+        freeze=tuple(p.strip() for p in cfg.train.freeze.split(",")
+                     if p.strip()) or None)
     opt_state = tx.init(params)
 
     schedule = None
