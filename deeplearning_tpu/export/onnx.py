@@ -1,0 +1,733 @@
+"""Self-contained jaxpr → ONNX exporter + load-back evaluator.
+
+The reference ships an ONNX deployment path (detection/yolov5/export.py:43
+``torch.onnx.export``; others/deploy/pytorch2onnx/support_new_ops.py —
+registering a symbolic for an op the exporter doesn't know). This image has
+neither the ``onnx`` package nor ``tf2onnx``/``onnxruntime``, so this module
+implements the whole path from first principles:
+
+- a minimal protobuf **wire-format** writer/reader for the stable public
+  ONNX schema (ModelProto/GraphProto/NodeProto/TensorProto/AttributeProto,
+  opset 12 — attribute-style Reduce* axes);
+- a jaxpr walker that lowers each primitive through ``ONNX_LOWERINGS``;
+- ``register_onnx_lowering`` — the ``support_new_ops.py`` ``g.op()``
+  symbolic-registration analog: models using a primitive outside the
+  built-in table register a lowering and export cleanly;
+- ``load_onnx``/``run_onnx`` — parse the serialized file back and evaluate
+  it (numpy + lax for conv/pool), so tests assert the ARTIFACT, not the
+  in-memory graph, matches the jax forward.
+
+Layout convention: tensors keep jax's layout (NHWC for images); Conv and
+MaxPool nodes are wrapped in Transpose pairs since ONNX defines them NCHW.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["export_onnx", "load_onnx", "run_onnx",
+           "register_onnx_lowering", "ONNX_LOWERINGS"]
+
+OPSET = 12
+IR_VERSION = 7            # IR for opset-12-era onnx releases
+
+# TensorProto.DataType
+_DTYPES = {
+    np.dtype("float32"): 1, np.dtype("uint8"): 2, np.dtype("int8"): 3,
+    np.dtype("int32"): 6, np.dtype("int64"): 7, np.dtype("bool"): 9,
+    np.dtype("float16"): 10, np.dtype("float64"): 11,
+}
+try:                       # BFLOAT16=16 (opset 13 tensor type; we emit it
+    import ml_dtypes       # only when the traced fn itself computes in bf16)
+    _DTYPES[np.dtype(ml_dtypes.bfloat16)] = 16
+except ImportError:        # pragma: no cover
+    pass
+_DTYPES_INV = {v: k for k, v in _DTYPES.items()}
+
+
+# --------------------------------------------------------------- protobuf
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _packed_ints(field: int, values: Sequence[int]) -> bytes:
+    if not values:
+        return b""
+    payload = b"".join(_varint(v) for v in values)
+    return _len_field(field, payload)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data, self.pos = data, 0
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+    def varint(self) -> int:
+        shift = result = 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def field(self) -> Tuple[int, int, Any]:
+        key = self.varint()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            return field, wire, self.varint()
+        if wire == 2:
+            n = self.varint()
+            blob = self.data[self.pos:self.pos + n]
+            self.pos += n
+            return field, wire, blob
+        if wire == 5:
+            blob = self.data[self.pos:self.pos + 4]
+            self.pos += 4
+            return field, wire, struct.unpack("<f", blob)[0]
+        if wire == 1:
+            blob = self.data[self.pos:self.pos + 8]
+            self.pos += 8
+            return field, wire, struct.unpack("<d", blob)[0]
+        raise ValueError(f"unsupported wire type {wire}")
+
+
+def _read_packed_ints(blob: bytes) -> List[int]:
+    r = _Reader(blob)
+    out = []
+    while not r.eof():
+        out.append(r.varint())
+    return out
+
+
+# ---------------------------------------------------------- proto builders
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _DTYPES:
+        raise ValueError(f"unsupported dtype {arr.dtype} for ONNX export")
+    parts = [
+        _packed_ints(1, arr.shape),              # dims
+        _int_field(2, _DTYPES[arr.dtype]),       # data_type
+        _len_field(8, name.encode()),            # name
+        _len_field(9, arr.tobytes()),            # raw_data
+    ]
+    return b"".join(parts)
+
+
+def _value_info(name: str, shape: Sequence[int], dtype: np.dtype) -> bytes:
+    dims = b"".join(_len_field(1, _int_field(1, d)) for d in shape)
+    tensor_type = (_int_field(1, _DTYPES[np.dtype(dtype)])
+                   + _len_field(2, dims))
+    type_proto = _len_field(1, tensor_type)
+    return _len_field(1, name.encode()) + _len_field(2, type_proto)
+
+
+def _attribute(name: str, value: Any) -> bytes:
+    parts = [_len_field(1, name.encode())]
+    if isinstance(value, float):
+        parts += [_tag(2, 5) + struct.pack("<f", value), _int_field(20, 1)]
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        parts += [_int_field(3, int(value)), _int_field(20, 2)]
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        parts += [b"".join(_int_field(8, int(v)) for v in value),
+                  _int_field(20, 7)]
+    elif isinstance(value, str):
+        parts += [_len_field(4, value.encode()), _int_field(20, 3)]
+    else:
+        raise ValueError(f"unsupported attribute {name}={value!r}")
+    return b"".join(parts)
+
+
+def _node_proto(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+                attrs: Dict[str, Any], domain: str = "") -> bytes:
+    parts = [_len_field(1, i.encode()) for i in inputs]
+    parts += [_len_field(2, o.encode()) for o in outputs]
+    parts += [_len_field(4, op_type.encode())]
+    parts += [_len_field(5, _attribute(k, v)) for k, v in attrs.items()]
+    if domain:
+        parts += [_len_field(7, domain.encode())]
+    return b"".join(parts)
+
+
+# ----------------------------------------------------------- graph builder
+
+class _GraphBuilder:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self._names: Dict[Any, str] = {}
+        self._const_cache: Dict[Any, str] = {}
+        self._counter = 0
+
+    def fresh(self, hint: str = "t") -> str:
+        self._counter += 1
+        return f"{hint}_{self._counter}"
+
+    def node(self, op_type: str, inputs: Sequence[str],
+             outputs: Optional[Sequence[str]] = None,
+             domain: str = "", **attrs) -> str:
+        outputs = list(outputs) if outputs else [self.fresh(op_type.lower())]
+        self.nodes.append(_node_proto(op_type, inputs, outputs, attrs,
+                                      domain))
+        return outputs[0]
+
+    def constant(self, arr: np.ndarray, hint: str = "const") -> str:
+        arr = np.asarray(arr)
+        # dedupe small constants (jaxpr literals recur per-op: BN eps adds,
+        # activation thresholds, reshape targets)
+        key = None
+        if arr.size <= 64:
+            key = (str(arr.dtype), arr.shape, arr.tobytes())
+            if key in self._const_cache:
+                return self._const_cache[key]
+        name = self.fresh(hint)
+        self.initializers.append(_tensor_proto(name, arr))
+        if key is not None:
+            self._const_cache[key] = name
+        return name
+
+    def name_of(self, var) -> str:
+        if type(var).__name__ == "Literal":
+            return self.constant(np.asarray(var.val, var.aval.dtype), "lit")
+        if var not in self._names:
+            self._names[var] = self.fresh("v")
+        return self._names[var]
+
+    def bind(self, var, name: str):
+        self._names[var] = name
+
+
+# ------------------------------------------------------ lowering registry
+
+ONNX_LOWERINGS: Dict[str, Callable] = {}
+
+
+def register_onnx_lowering(primitive_name: str):
+    """Register a jax-primitive → ONNX lowering — the analog of the
+    reference's symbolic registration for unsupported ops
+    (others/deploy/pytorch2onnx/support_new_ops.py ``g.op()``). The
+    function receives (builder, eqn, in_names, out_names) and emits nodes
+    via ``builder.node``."""
+    def deco(fn):
+        ONNX_LOWERINGS[primitive_name] = fn
+        return fn
+    return deco
+
+
+def _simple(op_type: str):
+    def lower(g, eqn, ins, outs):
+        g.node(op_type, ins, outs)
+    return lower
+
+
+for _prim, _op in [
+        ("add", "Add"), ("sub", "Sub"), ("mul", "Mul"), ("div", "Div"),
+        ("max", "Max"), ("min", "Min"), ("pow", "Pow"), ("neg", "Neg"),
+        ("exp", "Exp"), ("log", "Log"), ("tanh", "Tanh"), ("sqrt", "Sqrt"),
+        ("erf", "Erf"), ("logistic", "Sigmoid"), ("abs", "Abs"),
+        ("sign", "Sign"), ("floor", "Floor"), ("ceil", "Ceil"),
+        ("stop_gradient", "Identity"), ("copy", "Identity"),
+        ("eq", "Equal"), ("lt", "Less"), ("gt", "Greater"),
+        ("le", "LessOrEqual"), ("ge", "GreaterOrEqual"),
+        ("and", "And"), ("or", "Or"), ("not", "Not"),
+]:
+    ONNX_LOWERINGS[_prim] = _simple(_op)
+
+
+@register_onnx_lowering("erfc")
+def _erfc(g, eqn, ins, outs):
+    one = g.constant(np.asarray(1.0, np.float32))
+    e = g.node("Erf", ins)
+    g.node("Sub", [one, e], outs)
+
+
+@register_onnx_lowering("square")
+def _square(g, eqn, ins, outs):
+    g.node("Mul", [ins[0], ins[0]], outs)
+
+
+@register_onnx_lowering("rsqrt")
+def _rsqrt(g, eqn, ins, outs):
+    s = g.node("Sqrt", ins)
+    g.node("Reciprocal", [s], outs)
+
+
+@register_onnx_lowering("integer_pow")
+def _integer_pow(g, eqn, ins, outs):
+    y = g.constant(np.asarray(float(eqn.params["y"]), np.float32))
+    g.node("Pow", [ins[0], y], outs)
+
+
+@register_onnx_lowering("select_n")
+def _select_n(g, eqn, ins, outs):
+    if len(ins) != 3:
+        raise NotImplementedError("select_n with >2 cases")
+    # select_n(pred, on_false, on_true) → Where(pred, on_true, on_false)
+    g.node("Where", [ins[0], ins[2], ins[1]], outs)
+
+
+@register_onnx_lowering("convert_element_type")
+def _convert(g, eqn, ins, outs):
+    to = _DTYPES[np.dtype(eqn.params["new_dtype"])]
+    g.node("Cast", ins, outs, to=to)
+
+
+def _shape_only(g, eqn, ins, outs):
+    """Static-shape Reshape covers reshape/squeeze/expand_dims alike."""
+    shape = g.constant(np.asarray(eqn.outvars[0].aval.shape, np.int64))
+    g.node("Reshape", [ins[0], shape], outs)
+
+
+for _prim in ("reshape", "squeeze", "expand_dims"):
+    ONNX_LOWERINGS[_prim] = _shape_only
+
+
+@register_onnx_lowering("transpose")
+def _transpose(g, eqn, ins, outs):
+    g.node("Transpose", ins, outs,
+           perm=[int(p) for p in eqn.params["permutation"]])
+
+
+@register_onnx_lowering("broadcast_in_dim")
+def _broadcast_in_dim(g, eqn, ins, outs):
+    target = eqn.outvars[0].aval.shape
+    bdims = eqn.params["broadcast_dimensions"]
+    # reshape to put existing dims at their broadcast positions...
+    interm = [1] * len(target)
+    for src_axis, dst_axis in enumerate(bdims):
+        interm[dst_axis] = eqn.invars[0].aval.shape[src_axis]
+    shape = g.constant(np.asarray(interm, np.int64))
+    reshaped = g.node("Reshape", [ins[0], shape])
+    # ...then Expand to the full target
+    tgt = g.constant(np.asarray(target, np.int64))
+    g.node("Expand", [reshaped, tgt], outs)
+
+
+@register_onnx_lowering("concatenate")
+def _concatenate(g, eqn, ins, outs):
+    g.node("Concat", ins, outs, axis=int(eqn.params["dimension"]))
+
+
+@register_onnx_lowering("slice")
+def _slice(g, eqn, ins, outs):
+    starts = eqn.params["start_indices"]
+    ends = eqn.params["limit_indices"]
+    steps = eqn.params["strides"] or (1,) * len(starts)
+    axes = list(range(len(starts)))
+    g.node("Slice", [
+        ins[0],
+        g.constant(np.asarray(starts, np.int64)),
+        g.constant(np.asarray(ends, np.int64)),
+        g.constant(np.asarray(axes, np.int64)),
+        g.constant(np.asarray(steps, np.int64))], outs)
+
+
+def _reduce(op_type: str):
+    def lower(g, eqn, ins, outs):
+        axes = [int(a) for a in eqn.params["axes"]]
+        g.node(op_type, ins, outs, axes=axes, keepdims=0)
+    return lower
+
+
+ONNX_LOWERINGS["reduce_sum"] = _reduce("ReduceSum")
+ONNX_LOWERINGS["reduce_max"] = _reduce("ReduceMax")
+ONNX_LOWERINGS["reduce_min"] = _reduce("ReduceMin")
+ONNX_LOWERINGS["reduce_prod"] = _reduce("ReduceProd")
+
+
+@register_onnx_lowering("dot_general")
+def _dot_general(g, eqn, ins, outs):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    if len(lc) != 1 or len(rc) != 1:
+        raise NotImplementedError("dot_general with multiple contractions")
+    lc, rc = lc[0], rc[0]
+
+    def normalize(name, aval, batch, contract, contract_last):
+        free = [d for d in range(len(aval.shape))
+                if d not in batch and d != contract]
+        perm = list(batch) + free + [contract] if contract_last else \
+            list(batch) + [contract] + free
+        if perm != list(range(len(aval.shape))):
+            name = g.node("Transpose", [name], perm=perm)
+        b = int(np.prod([aval.shape[d] for d in batch])) if batch else 1
+        f = int(np.prod([aval.shape[d] for d in free])) if free else 1
+        c = aval.shape[contract]
+        shape3 = [b, f, c] if contract_last else [b, c, f]
+        name = g.node("Reshape", [
+            name, g.constant(np.asarray(shape3, np.int64))])
+        free_shape = [aval.shape[d] for d in free]
+        return name, free_shape
+
+    ln, lfree = normalize(ins[0], lhs, lb, lc, True)
+    rn, rfree = normalize(ins[1], rhs, rb, rc, False)
+    mm = g.node("MatMul", [ln, rn])
+    out_shape = eqn.outvars[0].aval.shape
+    g.node("Reshape", [mm, g.constant(np.asarray(out_shape, np.int64))],
+           outs)
+
+
+@register_onnx_lowering("conv_general_dilated")
+def _conv(g, eqn, ins, outs):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if (dn.lhs_spec, dn.rhs_spec, dn.out_spec) != \
+            ((0, 3, 1, 2), (3, 2, 0, 1), (0, 3, 1, 2)):
+        raise NotImplementedError(
+            f"conv dimension_numbers {dn} (expected NHWC/HWIO/NHWC)")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv")
+    x = g.node("Transpose", [ins[0]], perm=[0, 3, 1, 2])      # NHWC→NCHW
+    w = g.node("Transpose", [ins[1]], perm=[3, 2, 0, 1])      # HWIO→OIHW
+    (ph0, ph1), (pw0, pw1) = p["padding"]
+    y = g.node("Conv", [x, w],
+               strides=[int(s) for s in p["window_strides"]],
+               pads=[int(ph0), int(pw0), int(ph1), int(pw1)],
+               dilations=[int(d) for d in p["rhs_dilation"]],
+               group=int(p["feature_group_count"]))
+    g.node("Transpose", [y], outs, perm=[0, 2, 3, 1])         # NCHW→NHWC
+
+
+@register_onnx_lowering("reduce_window_max")
+def _reduce_window_max(g, eqn, ins, outs):
+    p = eqn.params
+    win, strides, pad = (p["window_dimensions"], p["window_strides"],
+                         p["padding"])
+    if win[0] != 1 or win[3] != 1 or strides[0] != 1 or strides[3] != 1 \
+            or pad[0] != (0, 0) or pad[3] != (0, 0):
+        raise NotImplementedError("reduce_window_max beyond NHWC pooling")
+    if any(d != 1 for d in p.get("base_dilation", (1,) * 4)) or \
+            any(d != 1 for d in p.get("window_dilation", (1,) * 4)):
+        raise NotImplementedError("dilated pooling")
+    x = g.node("Transpose", ins, perm=[0, 3, 1, 2])
+    y = g.node("MaxPool", [x],
+               kernel_shape=[int(win[1]), int(win[2])],
+               strides=[int(strides[1]), int(strides[2])],
+               pads=[int(pad[1][0]), int(pad[2][0]),
+                     int(pad[1][1]), int(pad[2][1])])
+    g.node("Transpose", [y], outs, perm=[0, 2, 3, 1])
+
+
+# ---------------------------------------------------------------- export
+
+_INLINE = ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
+           "custom_jvp_call_jaxpr", "remat", "checkpoint",
+           "custom_vjp_call_jaxpr")
+
+
+def _walk(g: _GraphBuilder, jaxpr) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _INLINE:
+            sub = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                if key in eqn.params:
+                    sub = eqn.params[key]
+                    break
+            if sub is None:
+                raise NotImplementedError(f"cannot inline {name}")
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            consts = getattr(sub, "consts", getattr(inner, "consts", []))
+            # jax CACHES sub-jaxprs (the relu custom_jvp jaxpr for a given
+            # shape is one object reused at every call site), so inner Var
+            # objects recur across inlinings — bind them in a scratch scope
+            # that is dropped afterwards, or every later inlining would
+            # reuse the first one's output names and corrupt the dataflow.
+            saved = g._names
+            g._names = dict(saved)
+            for cv, c in zip(inner.constvars, consts):
+                g.bind(cv, g.constant(np.asarray(c)))
+            for iv, outer in zip(inner.invars, eqn.invars):
+                g.bind(iv, g.name_of(outer))
+            _walk(g, inner)
+            out_names = [g.name_of(ov) for ov in inner.outvars]
+            g._names = saved
+            for outer, nm in zip(eqn.outvars, out_names):
+                g.bind(outer, nm)
+            continue
+        if name not in ONNX_LOWERINGS:
+            raise NotImplementedError(
+                f"no ONNX lowering for primitive '{name}'; add one with "
+                "register_onnx_lowering (the support_new_ops.py analog)")
+        ins = [g.name_of(v) for v in eqn.invars]
+        outs = [g.name_of(v) for v in eqn.outvars]
+        ONNX_LOWERINGS[name](g, eqn, ins, outs)
+
+
+def export_onnx(fn: Callable, example_args: Sequence[Any],
+                path: Optional[str] = None,
+                graph_name: str = "deeplearning_tpu") -> bytes:
+    """Trace ``fn`` on ``example_args`` and serialize the jaxpr as an ONNX
+    ModelProto (opset 12). Returns the bytes; writes ``path`` if given."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    g = _GraphBuilder()
+
+    flat_args = jax.tree.leaves(list(example_args))
+    if len(jaxpr.invars) != len(flat_args):
+        raise ValueError(
+            f"traced fn has {len(jaxpr.invars)} array inputs but "
+            f"example_args flattened to {len(flat_args)} leaves")
+    inputs = []
+    for i, var in enumerate(jaxpr.invars):
+        name = f"input_{i}"
+        g.bind(var, name)
+        inputs.append(_value_info(name, var.aval.shape,
+                                  np.dtype(var.aval.dtype)))
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        g.bind(cv, g.constant(np.asarray(c), "w"))
+
+    _walk(g, jaxpr)
+
+    outputs = []
+    out_renames = []
+    for i, var in enumerate(jaxpr.outvars):
+        name = f"output_{i}"
+        out_renames.append(_node_proto("Identity", [g.name_of(var)],
+                                       [name], {}))
+        outputs.append(_value_info(name, var.aval.shape,
+                                   np.dtype(var.aval.dtype)))
+
+    graph = b"".join(
+        [_len_field(1, n) for n in g.nodes + out_renames]
+        + [_len_field(2, graph_name.encode())]
+        + [_len_field(5, t) for t in g.initializers]
+        + [_len_field(11, i) for i in inputs]
+        + [_len_field(12, o) for o in outputs])
+    opset = _int_field(2, OPSET)                   # default domain ""
+    model = b"".join([
+        _int_field(1, IR_VERSION),
+        _len_field(2, b"deeplearning_tpu"),
+        _len_field(7, graph),
+        _len_field(8, opset),
+    ])
+    if path:
+        with open(path, "wb") as f:
+            f.write(model)
+    return model
+
+
+# ------------------------------------------------------------------ load
+
+def _parse_tensor(blob: bytes) -> Tuple[str, np.ndarray]:
+    r = _Reader(blob)
+    dims: List[int] = []
+    dtype = 1
+    raw = b""
+    name = ""
+    while not r.eof():
+        field, wire, val = r.field()
+        if field == 1:
+            dims += _read_packed_ints(val) if wire == 2 else [val]
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    arr = np.frombuffer(raw, _DTYPES_INV[dtype]).reshape(dims)
+    return name, arr
+
+
+def _parse_attr(blob: bytes) -> Tuple[str, Any]:
+    r = _Reader(blob)
+    name, value, ints = "", None, []
+    while not r.eof():
+        field, wire, val = r.field()
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            value = val
+        elif field == 3:
+            value = val
+        elif field == 4:
+            value = val.decode()
+        elif field == 8:
+            ints += _read_packed_ints(val) if wire == 2 else [val]
+    return name, (ints if ints else value)
+
+
+def _parse_node(blob: bytes) -> Dict[str, Any]:
+    r = _Reader(blob)
+    node = {"inputs": [], "outputs": [], "op": "", "attrs": {}}
+    while not r.eof():
+        field, wire, val = r.field()
+        if field == 1:
+            node["inputs"].append(val.decode())
+        elif field == 2:
+            node["outputs"].append(val.decode())
+        elif field == 4:
+            node["op"] = val.decode()
+        elif field == 5:
+            k, v = _parse_attr(val)
+            node["attrs"][k] = v
+    return node
+
+
+def _parse_value_info(blob: bytes) -> str:
+    r = _Reader(blob)
+    while not r.eof():
+        field, wire, val = r.field()
+        if field == 1:
+            return val.decode()
+    return ""
+
+
+def load_onnx(data: bytes) -> Dict[str, Any]:
+    """Parse serialized ONNX bytes into {nodes, initializers, inputs,
+    outputs}."""
+    r = _Reader(data)
+    graph_blob = None
+    while not r.eof():
+        field, wire, val = r.field()
+        if field == 7:
+            graph_blob = val
+    if graph_blob is None:
+        raise ValueError("no GraphProto in model")
+    g = _Reader(graph_blob)
+    out = {"nodes": [], "initializers": {}, "inputs": [], "outputs": []}
+    while not g.eof():
+        field, wire, val = g.field()
+        if field == 1:
+            out["nodes"].append(_parse_node(val))
+        elif field == 5:
+            name, arr = _parse_tensor(val)
+            out["initializers"][name] = arr
+        elif field == 11:
+            out["inputs"].append(_parse_value_info(val))
+        elif field == 12:
+            out["outputs"].append(_parse_value_info(val))
+    return out
+
+
+# ------------------------------------------------------------- evaluator
+
+def _np_cast(arr, to):
+    return np.asarray(arr).astype(_DTYPES_INV[to])
+
+
+def _np_slice(x):
+    data, starts, ends = x[0], x[1], x[2]
+    axes = x[3] if len(x) > 3 else np.arange(len(starts))
+    steps = x[4] if len(x) > 4 else np.ones(len(starts), np.int64)
+    idx = [slice(None)] * data.ndim
+    for a, s0, e0, st in zip(axes, starts, ends, steps):
+        idx[int(a)] = slice(int(s0), int(e0), int(st))
+    return data[tuple(idx)]
+
+
+def _eval_node(node: Dict[str, Any], vals: Dict[str, np.ndarray]):
+    import jax.numpy as jnp
+    from jax import lax
+
+    op = node["op"]
+    A = node["attrs"]
+    x = [np.asarray(vals[i]) for i in node["inputs"]]
+    if op == "Conv":
+        y = lax.conv_general_dilated(
+            jnp.asarray(x[0]), jnp.asarray(x[1]),
+            window_strides=tuple(A["strides"]),
+            padding=[(A["pads"][0], A["pads"][2]),
+                     (A["pads"][1], A["pads"][3])],
+            rhs_dilation=tuple(A.get("dilations", [1, 1])),
+            feature_group_count=int(A.get("group", 1)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return np.asarray(y)
+    if op == "MaxPool":
+        pads = A.get("pads", [0, 0, 0, 0])
+        y = lax.reduce_window(
+            jnp.asarray(x[0]), -np.inf, lax.max,
+            (1, 1) + tuple(A["kernel_shape"]),
+            (1, 1) + tuple(A["strides"]),
+            [(0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])])
+        return np.asarray(y)
+    if op == "Erf":
+        from jax.scipy.special import erf
+        return np.asarray(erf(jnp.asarray(x[0])))
+    simple = {
+        "Add": lambda: x[0] + x[1], "Sub": lambda: x[0] - x[1],
+        "Mul": lambda: x[0] * x[1], "Div": lambda: x[0] / x[1],
+        "Max": lambda: np.maximum(x[0], x[1]),
+        "Min": lambda: np.minimum(x[0], x[1]),
+        "Pow": lambda: np.power(x[0], x[1]),
+        "Neg": lambda: -x[0], "Exp": lambda: np.exp(x[0]),
+        "Log": lambda: np.log(x[0]), "Tanh": lambda: np.tanh(x[0]),
+        "Sqrt": lambda: np.sqrt(x[0]),
+        "Reciprocal": lambda: 1.0 / x[0],
+        "Sigmoid": lambda: 1.0 / (1.0 + np.exp(-x[0])),
+        "Abs": lambda: np.abs(x[0]), "Sign": lambda: np.sign(x[0]),
+        "Floor": lambda: np.floor(x[0]), "Ceil": lambda: np.ceil(x[0]),
+        "Identity": lambda: x[0],
+        "Equal": lambda: x[0] == x[1], "Less": lambda: x[0] < x[1],
+        "Greater": lambda: x[0] > x[1],
+        "LessOrEqual": lambda: x[0] <= x[1],
+        "GreaterOrEqual": lambda: x[0] >= x[1],
+        "And": lambda: np.logical_and(x[0], x[1]),
+        "Or": lambda: np.logical_or(x[0], x[1]),
+        "Not": lambda: np.logical_not(x[0]),
+        "Where": lambda: np.where(x[0], x[1], x[2]),
+        "MatMul": lambda: np.matmul(x[0], x[1]),
+        "Reshape": lambda: x[0].reshape([int(d) for d in x[1]]),
+        "Expand": lambda: np.broadcast_to(
+            x[0], [int(d) for d in x[1]]).copy(),
+        "Concat": lambda: np.concatenate(x, axis=int(A["axis"])),
+        "Transpose": lambda: np.transpose(x[0], A["perm"]),
+        "Cast": lambda: _np_cast(x[0], int(A["to"])),
+        "ReduceSum": lambda: np.sum(
+            x[0], axis=tuple(A["axes"]), keepdims=bool(A["keepdims"])),
+        "ReduceMax": lambda: np.max(
+            x[0], axis=tuple(A["axes"]), keepdims=bool(A["keepdims"])),
+        "ReduceMin": lambda: np.min(
+            x[0], axis=tuple(A["axes"]), keepdims=bool(A["keepdims"])),
+        "ReduceProd": lambda: np.prod(
+            x[0], axis=tuple(A["axes"]), keepdims=bool(A["keepdims"])),
+        "Slice": lambda: _np_slice(x),
+    }
+    if op not in simple:
+        raise NotImplementedError(f"evaluator: unsupported op {op}")
+    return simple[op]()
+
+
+def run_onnx(graph: Dict[str, Any], *inputs: np.ndarray
+             ) -> List[np.ndarray]:
+    """Evaluate a parsed graph on numpy inputs (topological node order as
+    serialized — the exporter emits in dependency order)."""
+    vals: Dict[str, np.ndarray] = dict(graph["initializers"])
+    for name, arr in zip(graph["inputs"], inputs):
+        vals[name] = np.asarray(arr)
+    for node in graph["nodes"]:
+        out = _eval_node(node, vals)
+        outs = node["outputs"]
+        if len(outs) != 1:
+            raise NotImplementedError("multi-output node")
+        vals[outs[0]] = out
+    return [vals[o] for o in graph["outputs"]]

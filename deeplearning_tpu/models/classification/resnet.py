@@ -210,7 +210,7 @@ class ResNet(nn.Module):
                        use_running_average=(not train) or self.frozen_bn,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=torch_pad(7),
                     use_bias=False, dtype=self.dtype, name="conv1")(x)
         x = norm(name="bn1")(x)
         x = nn.relu(x)

@@ -5,6 +5,8 @@ surface: one flag per backend).
   python tools/export.py --model vit_base_patch16_224 --num-classes 1000 \\
       --size 224 --format stablehlo --out model.shlo
   python tools/export.py --model resnet50 --format savedmodel --out sm/
+  python tools/export.py --model mnist_cnn --channels 1 --size 28 \\
+      --format onnx --out model.onnx
 """
 
 from __future__ import annotations
@@ -33,7 +35,8 @@ def main(argv=None) -> int:
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--channels", type=int, default=3)
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--format", choices=("stablehlo", "savedmodel"),
+    ap.add_argument("--format",
+                    choices=("stablehlo", "savedmodel", "onnx"),
                     default="stablehlo")
     ap.add_argument("--out", required=True)
     args = ap.parse_args(argv)
@@ -44,7 +47,11 @@ def main(argv=None) -> int:
                                                    export_stablehlo,
                                                    flops_estimate)
 
-    model = MODELS.build(args.model, num_classes=args.num_classes)
+    build_kw = {}
+    if args.format == "onnx":
+        build_kw["dtype"] = jnp.float32   # portable f32 ONNX artifact
+    model = MODELS.build(args.model, num_classes=args.num_classes,
+                         **build_kw)
     example = jnp.zeros((args.batch, args.size, args.size, args.channels))
     variables = model.init(jax.random.key(0), example, train=False)
     if args.ckpt:
@@ -58,7 +65,23 @@ def main(argv=None) -> int:
 
     print(f"model FLOPs (fwd, batch {args.batch}): "
           f"{flops_estimate(fn, example) / 1e9:.2f} G")
-    if args.format == "stablehlo":
+    if args.format == "onnx":
+        from deeplearning_tpu.export.onnx import (export_onnx, load_onnx,
+                                                  run_onnx)
+        blob = export_onnx(fn, [example], args.out)
+        # load-back numeric self-check, the export.py --simplify/check
+        # analog (yolov5 export.py:43 onnx.checker + simplifier). A random
+        # probe, not zeros: conv(0)=0 would mask a mis-serialized stem.
+        probe = jnp.asarray(np.random.default_rng(0).normal(
+            size=example.shape), jnp.float32)
+        got = run_onnx(load_onnx(blob), np.asarray(probe))[0]
+        want = np.asarray(fn(probe))
+        err = float(np.abs(got - want).max())
+        print(f"wrote {len(blob)} bytes of ONNX to {args.out}; "
+              f"load-back max|diff|={err:.2e}")
+        if err > 1e-3:
+            print("ONNX self-check FAILED"); return 1
+    elif args.format == "stablehlo":
         blob = export_stablehlo(fn, [example], args.out)
         print(f"wrote {len(blob)} bytes of StableHLO to {args.out}")
     else:
