@@ -440,8 +440,8 @@ def _reduce_window_max(g, eqn, ins, outs):
 # ---------------------------------------------------------------- export
 
 _INLINE = ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
-           "custom_jvp_call_jaxpr", "remat", "checkpoint",
-           "custom_vjp_call_jaxpr")
+           "custom_jvp_call_jaxpr", "remat", "remat2",
+           "checkpoint", "custom_vjp_call_jaxpr")
 
 
 def _walk(g: _GraphBuilder, jaxpr) -> None:

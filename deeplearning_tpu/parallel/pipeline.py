@@ -68,7 +68,7 @@ def _pipeline_schedule(
         n_ticks = s + m - 1
         perm = [(i, (i + 1) % s) for i in range(s)]
 
-        def tick(t, carry):
+        def tick(carry, t):
             buf, outputs = carry
             # stage 0 ingests microbatch t (if any) — other stages use buf
             feed = jnp.where(t < m, t, 0)
@@ -86,11 +86,15 @@ def _pipeline_schedule(
                 lambda o: o, outputs)
             # rotate activations forward one stage
             buf = jax.lax.ppermute(y, axis_name, perm)
-            return buf, outputs
+            return (buf, outputs), None
 
         buf0 = jnp.zeros_like(all_x[0])
         outputs0 = jnp.zeros_like(all_x)
-        _, outputs = jax.lax.fori_loop(0, n_ticks, tick, (buf0, outputs0))
+        # scan, not fori_loop: the trip count is static and scan is
+        # reverse-mode differentiable, so the SAME schedule serves the
+        # training step (grads flow back through ppermute/psum)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (buf0, outputs0), jnp.arange(n_ticks))
         # outputs live on the last stage; share them back to all devices
         outputs = jax.lax.psum(outputs, axis_name)
         # return this device's storage shard

@@ -78,6 +78,9 @@ class TrainCfg:
     accum_steps: int = 1             # gradient accumulation microbatches
     mixup: bool = False              # mixup/cutmix soft targets
     async_checkpoint: bool = False   # overlap Orbax writes with training
+    pipeline_stages: int = 1         # >1: GPipe pipeline over 'model' axis
+                                     # (ViT family; blocks split S-ways)
+    microbatches: int = 0            # pipeline microbatches (0 = stages)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,8 +120,19 @@ def main(argv=None) -> int:
     from deeplearning_tpu.train.trainer import Trainer
 
     cfg = config_cli(Config(), argv, description=__doc__)
-    mesh = build_mesh(MeshConfig(data=-1, model=cfg.train.mesh_model_axis,
-                                 seq=cfg.train.mesh_seq_axis))
+    pp_stages = cfg.train.pipeline_stages
+    if pp_stages > 1 and (cfg.train.mesh_model_axis > 1
+                          or cfg.train.mesh_seq_axis > 1):
+        raise ValueError("train.pipeline_stages reuses the 'model' mesh "
+                         "axis; unset mesh_model_axis/mesh_seq_axis")
+    if pp_stages > 1 and (cfg.train.mixup or cfg.train.ema
+                          or cfg.train.accum_steps > 1):
+        raise ValueError("pipeline_stages does not compose with "
+                         "mixup/ema/accum_steps yet")
+    mesh = build_mesh(MeshConfig(
+        data=-1,
+        model=pp_stages if pp_stages > 1 else cfg.train.mesh_model_axis,
+        seq=cfg.train.mesh_seq_axis))
     if cfg.data.folder:
         from deeplearning_tpu.data.build import (LoaderConfig,
                                                  build_classification_loaders)
@@ -168,6 +182,12 @@ def main(argv=None) -> int:
     variables = model.init(jax.random.key(cfg.train.seed), sample,
                            train=False)
     params = variables["params"]
+    k_per_stage = 0
+    if pp_stages > 1:
+        from deeplearning_tpu.parallel.pipeline_train import \
+            split_vit_params
+        outer, stages, k_per_stage = split_vit_params(params, pp_stages)
+        params = {"outer": outer, "stages": stages}
     steps_per_epoch = n_train // cfg.data.global_batch
     sched = build_schedule(cfg.optim.schedule, base_lr=cfg.optim.lr,
                            total_steps=cfg.train.epochs * steps_per_epoch,
@@ -181,7 +201,12 @@ def main(argv=None) -> int:
         batch_stats=variables.get("batch_stats", {}),
         use_ema=cfg.train.ema)
 
-    state = shard_state(state, mesh)
+    if pp_stages > 1:
+        from deeplearning_tpu.parallel.pipeline_train import \
+            shard_pipeline_state
+        state = shard_pipeline_state(state, mesh)
+    else:
+        state = shard_state(state, mesh)
     has_bn = bool(variables.get("batch_stats"))
     if not cfg.data.folder:
         loader = DataLoader(ArraySource(image=images, label=labels),
@@ -194,9 +219,27 @@ def main(argv=None) -> int:
         raise ValueError(
             f"data.global_batch={cfg.data.global_batch} must be divisible "
             f"by train.accum_steps={cfg.train.accum_steps}")
-    base_step = make_train_step(
-        make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh,
-        accum_steps=cfg.train.accum_steps)
+    if pp_stages > 1:
+        from deeplearning_tpu.parallel.pipeline_train import \
+            make_pipeline_train_step
+        micro = cfg.train.microbatches or pp_stages
+        if micro % pp_stages:
+            raise ValueError(
+                f"train.microbatches={micro} must be divisible by "
+                f"train.pipeline_stages={pp_stages} (microbatch storage "
+                "shards over the pipe axis)")
+        if cfg.data.global_batch % micro:
+            raise ValueError(
+                f"data.global_batch={cfg.data.global_batch} must be "
+                f"divisible by train.microbatches={micro}")
+        base_step, pp_eval_step = make_pipeline_train_step(
+            model, mesh, tx, num_stages=pp_stages,
+            k_per_stage=k_per_stage, microbatches=micro,
+            label_smoothing=cfg.train.label_smoothing)
+    else:
+        base_step = make_train_step(
+            make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh,
+            accum_steps=cfg.train.accum_steps)
     if cfg.train.mixup:
         from deeplearning_tpu.core import rng as rng_mod
         from deeplearning_tpu.data.mixup import mixup_cutmix
@@ -216,7 +259,8 @@ def main(argv=None) -> int:
         state=state,
         train_step=train_step,
         train_loader=loader,
-        eval_step=make_eval_step(make_metric_fn()),
+        eval_step=(pp_eval_step if pp_stages > 1
+                   else make_eval_step(make_metric_fn())),
         eval_loader=eval_loader,
         epochs=cfg.train.epochs,
         seed=cfg.train.seed,
