@@ -38,13 +38,17 @@ def coco_detection_source(json_path: Optional[str] = None,
                           augment: bool = False, seed: int = 0,
                           records: Optional[Sequence[Dict]] = None,
                           class_names: Optional[Sequence[str]] = None,
+                          mosaic: bool = False,
+                          perspective: Optional[Dict] = None,
                           ) -> Tuple[MapSource, Sequence[str]]:
     """MapSource of fixed-shape samples {image, boxes, labels, valid}
     decoded lazily from disk. ``augment`` adds horizontal flip (the
-    YOLOX/fasterRcnn baseline transform; mosaic/mixup compose on top via
-    data.mixup utilities). Pass pre-parsed ``records``/``class_names``
-    (from load_coco_json) to build several sources — e.g. augmented
-    train + raw val — without re-parsing the json."""
+    YOLOX/fasterRcnn baseline transform). ``mosaic`` makes every sample
+    a fresh 4-image mosaic (MosaicDetection / yolov5 load_mosaic flow),
+    and ``perspective`` threads random_perspective kwargs through it
+    (yolov5 utils/datasets.py:836). Pass pre-parsed ``records``/
+    ``class_names`` (from load_coco_json) to build several sources —
+    e.g. augmented train + raw val — without re-parsing the json."""
     if records is None:
         if json_path is None:
             raise ValueError("need json_path or records")
@@ -59,13 +63,41 @@ def coco_detection_source(json_path: Optional[str] = None,
     import threading
     local = threading.local()
 
+    def _load_raw(i: int):
+        rec = records[i]
+        img = load_image(os.path.join(images_dir, rec["filename"]))
+        labels = np.asarray([name_to_id[x] for x in rec["names"]],
+                            np.int64)
+        return (np.asarray(img, np.float32),
+                np.asarray(rec["boxes"], np.float32).reshape(-1, 4),
+                labels)
+
     def fetch(i: int) -> Dict[str, np.ndarray]:
+        rng = thread_rng(local, seed)
+        if mosaic:
+            from .mixup import mosaic4
+            idxs = [i] + [int(rng.integers(0, len(records)))
+                          for _ in range(3)]
+            raws = [_load_raw(j) for j in idxs]
+            # a mosaic merges 4 images' boxes: pad to 4*max_gt so no
+            # ground truth is silently dropped (loss masks by valid)
+            img, boxes, labels, pvalid = mosaic4(
+                [r[0] for r in raws], [r[1] for r in raws],
+                [r[2] for r in raws], image_size, rng,
+                max_boxes=4 * max_gt, perspective=perspective,
+                fill=114.0)
+            if augment and rng.uniform() < 0.5:
+                img = img[:, ::-1]
+                w = img.shape[1]
+                boxes = boxes.copy()
+                boxes[:, [0, 2]] = w - boxes[:, [2, 0]]
+            return {"image": img / 255.0, "boxes": boxes,
+                    "labels": labels, "valid": pvalid}
         rec = records[i]
         img = load_image(os.path.join(images_dir, rec["filename"]))
         img, _, boxes = resize_with_pad(img, out_hw, rec["boxes"])
         if augment:
-            img, boxes = random_flip_lr(img, thread_rng(local, seed),
-                                        boxes)
+            img, boxes = random_flip_lr(img, rng, boxes)
         pboxes = np.zeros((max_gt, 4), np.float32)
         plabels = np.zeros((max_gt,), np.int64)
         pvalid = np.zeros((max_gt,), bool)

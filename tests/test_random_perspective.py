@@ -1,0 +1,110 @@
+"""yolov5 random_perspective geometric augmentation
+(utils/augmentations.py:144) + its mosaic composition
+(utils/datasets.py:836) and CLI wiring."""
+
+import numpy as np
+import pytest
+
+from deeplearning_tpu.data.mixup import (box_candidates, mosaic4,
+                                         mosaic_array_source,
+                                         random_perspective)
+
+
+def _img_with_box(size=64):
+    img = np.zeros((size, size, 3), np.float32)
+    img[20:40, 24:44] = 200.0
+    boxes = np.asarray([[24, 20, 44, 40]], np.float32)
+    labels = np.asarray([2], np.int64)
+    return img, boxes, labels
+
+
+class TestRandomPerspective:
+    def test_identity_when_all_zero(self):
+        img, boxes, labels = _img_with_box()
+        out, b, l = random_perspective(
+            img, boxes, labels, np.random.default_rng(0),
+            degrees=0, translate=0, scale=0, shear=0)
+        # translate=0 recenters to exactly the same square frame
+        np.testing.assert_allclose(out, img, atol=1e-3)
+        np.testing.assert_allclose(b, boxes, atol=1e-3)
+        assert list(l) == [2]
+
+    def test_pure_scale_moves_boxes(self):
+        img, boxes, labels = _img_with_box()
+        rng = np.random.default_rng(3)
+        out, b, l = random_perspective(img, boxes, labels, rng,
+                                       degrees=0, translate=0, scale=0.5,
+                                       shear=0)
+        assert out.shape == img.shape
+        assert b.shape == (1, 4)
+        w0 = boxes[0, 2] - boxes[0, 0]
+        w1 = b[0, 2] - b[0, 0]
+        # box width scales with the drawn factor (0.5..1.5)
+        assert 0.45 * w0 <= w1 <= 1.55 * w0
+
+    def test_rotation_keeps_boxes_in_bounds(self):
+        img, boxes, labels = _img_with_box()
+        for seed in range(8):
+            out, b, l = random_perspective(
+                img, boxes, labels, np.random.default_rng(seed),
+                degrees=45, translate=0.2, scale=0.3, shear=10)
+            assert out.shape == img.shape
+            if len(b):
+                assert (b[:, [0, 2]] >= 0).all()
+                assert (b[:, [0, 2]] <= img.shape[1]).all()
+                assert (b[:, [1, 3]] >= 0).all()
+                assert (b[:, [1, 3]] <= img.shape[0]).all()
+                assert (b[:, 2] > b[:, 0]).all()
+                assert (b[:, 3] > b[:, 1]).all()
+
+    def test_box_candidates_filters_degenerate(self):
+        before = np.asarray([[0, 0, 20, 20], [0, 0, 20, 20]],
+                            np.float32).T
+        after = np.asarray([[0, 0, 20, 20], [0, 0, 1, 20]], np.float32).T
+        keep = box_candidates(before, after)
+        assert list(keep) == [True, False]
+
+    def test_mosaic_with_perspective(self):
+        rng = np.random.default_rng(0)
+        imgs, bxs, lbs = [], [], []
+        for _ in range(4):
+            i, b, l = _img_with_box()
+            imgs.append(i), bxs.append(b), lbs.append(l)
+        canvas, b, l, v = mosaic4(imgs, bxs, lbs, out_size=64, rng=rng,
+                                  max_boxes=8,
+                                  perspective=dict(degrees=10,
+                                                   translate=0.1,
+                                                   scale=0.5, shear=2),
+                                  fill=0.0)
+        assert canvas.shape == (64, 64, 3)
+        assert b.shape == (8, 4) and v.dtype == bool
+        if v.any():
+            assert (b[v] >= 0).all() and (b[v] <= 64).all()
+
+    def test_mosaic_array_source_contract(self):
+        n, s, g = 6, 32, 5
+        images = np.random.default_rng(0).normal(
+            0, 0.1, (n, s, s, 3)).astype(np.float32)
+        boxes = np.zeros((n, g, 4), np.float32)
+        labels = np.zeros((n, g), np.int64)
+        valid = np.zeros((n, g), bool)
+        boxes[:, 0] = [4, 4, 20, 20]
+        labels[:, 0] = 1
+        valid[:, 0] = True
+        src = mosaic_array_source(images, boxes, labels, valid,
+                                  out_size=s, max_boxes=g, seed=0,
+                                  perspective=dict(scale=0.3))
+        sample = src[2]
+        assert sample["image"].shape == (s, s, 3)
+        # 4 images' boxes merge: capacity is 4x per-image max_boxes
+        assert sample["boxes"].shape == (4 * g, 4)
+        assert sample["valid"].dtype == bool
+
+
+def test_detection_cli_mosaic_perspective():
+    from tools.train_detection import main
+    rc = main(["model.name=yolox_nano", "model.num_classes=3",
+               "model.image_size=64", "data.n_train=16", "data.batch=4",
+               "data.mosaic=true", "data.random_perspective=true",
+               "data.degrees=5", "train.steps=4"])
+    assert rc == 0

@@ -133,6 +133,11 @@ def main(argv=None) -> int:
         data=-1,
         model=pp_stages if pp_stages > 1 else cfg.train.mesh_model_axis,
         seq=cfg.train.mesh_seq_axis))
+    if pp_stages > 1 and mesh.shape["data"] > 1:
+        print(f"WARNING: pipeline_stages={pp_stages} uses only the "
+              f"{pp_stages}-device 'model' axis; the {mesh.shape['data']}"
+              "-way 'data' axis replicates work (DPxPP composition not "
+              "implemented yet) — set pipeline_stages = device count")
     if cfg.data.folder:
         from deeplearning_tpu.data.build import (LoaderConfig,
                                                  build_classification_loaders)

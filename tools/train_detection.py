@@ -55,6 +55,12 @@ class DetDataCfg:
     n_train: int = 32
     max_gt: int = 4
     batch: int = 8
+    mosaic: bool = False             # 4-image mosaic per sample
+    random_perspective: bool = False  # yolov5 geometric aug inside mosaic
+    degrees: float = 0.0             # hyp.scratch.yaml values
+    translate: float = 0.1
+    scale: float = 0.5
+    shear: float = 0.0
     val_rate: float = 0.1            # coco-mode eval split
     num_workers: int = 8             # coco-mode decode threads
 
@@ -315,6 +321,9 @@ def run(cfg) -> dict:
                          "YOLOX family")   # fail BEFORE training
     eval_max_det = 10
     train_src = val_src = None
+    persp = (dict(degrees=cfg.data.degrees, translate=cfg.data.translate,
+                  scale=cfg.data.scale, shear=cfg.data.shear)
+             if cfg.data.random_perspective else None)
     if cfg.data.coco:
         from deeplearning_tpu.data.coco import (coco_detection_source,
                                                 load_coco_json)
@@ -325,7 +334,8 @@ def run(cfg) -> dict:
         aug_src, _ = coco_detection_source(
             images_dir=images_dir, records=records,
             class_names=class_names, image_size=size,
-            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed)
+            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed,
+            mosaic=cfg.data.mosaic, perspective=persp)
         raw_src, _ = coco_detection_source(
             images_dir=images_dir, records=records,
             class_names=class_names, image_size=size,
@@ -352,6 +362,13 @@ def run(cfg) -> dict:
         images, boxes, labels, valid = synthetic_boxes(
             cfg.data.n_train, size, cfg.model.num_classes,
             cfg.data.max_gt, cfg.train.seed)
+    if cfg.data.mosaic and train_src is None:
+        # npz/synthetic arrays: every sample becomes a fresh mosaic
+        from deeplearning_tpu.data.mixup import mosaic_array_source
+        train_src = mosaic_array_source(
+            images, boxes, labels, valid, out_size=size,
+            max_boxes=cfg.data.max_gt, seed=cfg.train.seed,
+            perspective=persp, fill=float(np.median(images[0])))
 
     model_classes = num_classes + (
         1 if cfg.model.name.startswith("fasterrcnn") else 0)  # +background
