@@ -40,6 +40,7 @@ def coco_detection_source(json_path: Optional[str] = None,
                           class_names: Optional[Sequence[str]] = None,
                           mosaic: bool = False,
                           perspective: Optional[Dict] = None,
+                          mosaic_pool: Optional[Sequence[int]] = None,
                           ) -> Tuple[MapSource, Sequence[str]]:
     """MapSource of fixed-shape samples {image, boxes, labels, valid}
     decoded lazily from disk. ``augment`` adds horizontal flip (the
@@ -48,7 +49,10 @@ def coco_detection_source(json_path: Optional[str] = None,
     and ``perspective`` threads random_perspective kwargs through it
     (yolov5 utils/datasets.py:836). Pass pre-parsed ``records``/
     ``class_names`` (from load_coco_json) to build several sources —
-    e.g. augmented train + raw val — without re-parsing the json."""
+    e.g. augmented train + raw val — without re-parsing the json.
+    ``mosaic_pool`` restricts the 3 extra mosaic tiles to those record
+    indices (pass the TRAIN split so held-out val images never leak into
+    training mosaics)."""
     if records is None:
         if json_path is None:
             raise ValueError("need json_path or records")
@@ -76,7 +80,9 @@ def coco_detection_source(json_path: Optional[str] = None,
         rng = thread_rng(local, seed)
         if mosaic:
             from .mixup import mosaic4
-            idxs = [i] + [int(rng.integers(0, len(records)))
+            pool = (np.asarray(mosaic_pool) if mosaic_pool is not None
+                    else np.arange(len(records)))
+            idxs = [i] + [int(pool[rng.integers(0, len(pool))])
                           for _ in range(3)]
             raws = [_load_raw(j) for j in idxs]
             # a mosaic merges 4 images' boxes: pad to 4*max_gt so no
@@ -86,11 +92,8 @@ def coco_detection_source(json_path: Optional[str] = None,
                 [r[2] for r in raws], image_size, rng,
                 max_boxes=4 * max_gt, perspective=perspective,
                 fill=114.0)
-            if augment and rng.uniform() < 0.5:
-                img = img[:, ::-1]
-                w = img.shape[1]
-                boxes = boxes.copy()
-                boxes[:, [0, 2]] = w - boxes[:, [2, 0]]
+            if augment:
+                img, boxes = random_flip_lr(img, rng, boxes)
             return {"image": img / 255.0, "boxes": boxes,
                     "labels": labels, "valid": pvalid}
         rec = records[i]

@@ -242,7 +242,6 @@ def _warp_np(img: np.ndarray, M: np.ndarray, out_hw: Tuple[int, int],
         sx, sy = sx / src[..., 2], sy / src[..., 2]
     x0, y0 = np.floor(sx).astype(int), np.floor(sy).astype(int)
     fx, fy = (sx - x0)[..., None], (sy - y0)[..., None]
-    out = np.zeros((h, w, img.shape[-1]), np.float32)
 
     def tap(xi, yi):
         inside = (xi >= 0) & (xi < img.shape[1]) &                  (yi >= 0) & (yi < img.shape[0])

@@ -378,6 +378,12 @@ swinv2_base_patch4_window7_224 = _factory(
 swin_moe_tiny_patch4_window7_224 = _factory(
     "swin_moe_tiny_patch4_window7_224", embed_dim=96, depths=(2, 2, 6, 2),
     num_heads=(3, 6, 12, 24), moe=True)
+# small-image MoE config for the offline convergence runs (56px digits):
+# patch 2 / 28->14 token grid keeps the 7-window shifted path + merges
+swin_moe_micro_patch2_window7 = _factory(
+    "swin_moe_micro_patch2_window7", patch_size=2, embed_dim=32,
+    depths=(2, 2), num_heads=(2, 4), moe=True, num_experts=4,
+    drop_path_rate=0.0)
 # Swin-MLP variants (swin_mlp.py; configs/swin_mlp_*.yaml): cN = head dim,
 # heads per stage = stage dim / N
 swin_mlp_tiny_c24_patch4_window8_256 = _factory(

@@ -126,6 +126,20 @@ class MoEMlp(nn.Module):
             combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
 
         dispatch = (combine > 0).astype(tokens.dtype)            # (T,E,C)
+        # observability: the quantities that actually go wrong in MoE
+        # training (swin_transformer_moe.py:273 tunes capacity_factor
+        # against exactly these) — sown per layer, harvested by the
+        # trainer into step metrics
+        n_assigned = jnp.sum(dispatch, dtype=jnp.float32)
+        self.sow("moe_metrics", "drop_rate",
+                 1.0 - n_assigned / (t * self.top_k))
+        self.sow("moe_metrics", "capacity_util",
+                 n_assigned / (e * capacity))
+        per_expert = jnp.sum(dispatch, axis=(0, 2),
+                               dtype=jnp.float32)        # (E,)
+        self.sow("moe_metrics", "max_expert_load",
+                 jnp.max(per_expert) / jnp.maximum(
+                     jnp.mean(per_expert), 1.0))
         expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
         expert_out = ExpertMlp(e, int(d * self.hidden_ratio), d,
                                self.dtype, name="experts")(expert_in)

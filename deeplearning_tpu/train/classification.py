@@ -32,7 +32,8 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False,
         # "losses" collects model-internal auxiliary losses (e.g. MoE
         # load-balance, sown by MoEMlp) — always harvested into the loss
         logits, mutated = state.apply_fn(
-            variables, batch["image"], mutable=["batch_stats", "losses"],
+            variables, batch["image"],
+            mutable=["batch_stats", "losses", "moe_metrics"],
             **kwargs)
         if has_batch_stats:
             aux["batch_stats"] = mutated["batch_stats"]
@@ -56,6 +57,23 @@ def make_loss_fn(label_smoothing: float = 0.0, has_batch_stats: bool = False,
         acc = jnp.mean((jnp.argmax(logits, -1) == acc_labels).astype(
             jnp.float32))
         aux["metrics"] = {"accuracy": acc}
+        # surface per-layer MoE routing health as step metrics (mean over
+        # layers for drop/util, max over layers for load imbalance)
+        moe = mutated.get("moe_metrics", {})
+        if moe:
+            known = ("drop_rate", "capacity_util", "max_expert_load")
+            by_name: Dict[str, list] = {}
+            for path, leaf in jax.tree_util.tree_leaves_with_path(moe):
+                pstr = jax.tree_util.keystr(path)
+                name = next((k for k in known if k in pstr), None)
+                if name is None:
+                    continue
+                by_name.setdefault(name, []).append(jnp.mean(leaf))
+            for name, vals in by_name.items():
+                stacked = jnp.stack(vals)
+                aux["metrics"][f"moe/{name}"] = (
+                    jnp.max(stacked) if name == "max_expert_load"
+                    else jnp.mean(stacked))
         return loss, aux
     return loss_fn
 

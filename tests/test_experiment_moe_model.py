@@ -115,3 +115,36 @@ class TestSwinMoE:
         for a, b in zip(jax.tree.leaves(g_ep), jax.tree.leaves(g_ref)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-3)
+
+
+class TestMoEObservability:
+    """Per-layer routing health metrics (drop rate / capacity utilization /
+    load imbalance) surfaced as train-step metrics — the quantities
+    swin-moe tunes capacity_factor against
+    (swin_transformer_moe.py:273)."""
+
+    def test_moe_metrics_in_train_step(self):
+        import optax
+
+        from deeplearning_tpu.core import rng as rng_mod
+        from deeplearning_tpu.train import TrainState, make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+
+        model = MODELS.build("swin_moe_micro_patch2_window7",
+                             num_classes=4, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(8, 28, 28, 3)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 4, 8))
+        variables = model.init(jax.random.key(0), x, train=False)
+        state = TrainState.create(apply_fn=model.apply,
+                                  params=variables["params"],
+                                  tx=optax.adam(1e-3))
+        step = make_train_step(make_loss_fn())
+        state, metrics = step(state, {"image": x, "label": y},
+                              rng_mod.root_key(0))
+        for key in ("moe/drop_rate", "moe/capacity_util",
+                    "moe/max_expert_load"):
+            assert key in metrics, sorted(metrics)
+        assert 0.0 <= float(metrics["moe/drop_rate"]) <= 1.0
+        assert 0.0 < float(metrics["moe/capacity_util"]) <= 1.0
+        assert float(metrics["moe/max_expert_load"]) >= 1.0

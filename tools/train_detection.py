@@ -331,15 +331,6 @@ def run(cfg) -> dict:
         records, class_names = load_coco_json(cfg.data.coco)
         images_dir = cfg.data.coco_images or os.path.join(
             os.path.dirname(cfg.data.coco), "images")
-        aug_src, _ = coco_detection_source(
-            images_dir=images_dir, records=records,
-            class_names=class_names, image_size=size,
-            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed,
-            mosaic=cfg.data.mosaic, perspective=persp)
-        raw_src, _ = coco_detection_source(
-            images_dir=images_dir, records=records,
-            class_names=class_names, image_size=size,
-            max_gt=cfg.data.max_gt, augment=False)
         if cfg.model.num_classes != len(class_names):
             raise ValueError(
                 f"model.num_classes={cfg.model.num_classes} but "
@@ -347,9 +338,21 @@ def run(cfg) -> dict:
                 "set model.num_classes to match")
         num_classes = len(class_names)
         order = np.random.default_rng(cfg.train.seed).permutation(
-            len(aug_src))
-        n_val = max(int(len(aug_src) * cfg.data.val_rate), 1)
+            len(records))
+        n_val = max(int(len(records) * cfg.data.val_rate), 1)
         val_idx, tr_idx = order[:n_val], order[n_val:]
+        aug_src, _ = coco_detection_source(
+            images_dir=images_dir, records=records,
+            class_names=class_names, image_size=size,
+            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed,
+            mosaic=cfg.data.mosaic, perspective=persp,
+            # extra mosaic tiles must come from the TRAIN split only —
+            # drawing from all records would train on held-out val images
+            mosaic_pool=tr_idx)
+        raw_src, _ = coco_detection_source(
+            images_dir=images_dir, records=records,
+            class_names=class_names, image_size=size,
+            max_gt=cfg.data.max_gt, augment=False)
         train_src = MapSource(len(tr_idx),
                               lambda i: aug_src[int(tr_idx[i])])
         val_src = MapSource(len(val_idx),
