@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Offline convergence suite on the HARD digits datasets (VERDICT r3 #5).
+
+Generates the harder datasets if missing (100-class digit pairs with
+clutter, 4k-scene detection, 3k-scene segmentation), then runs the
+training CLIs sequentially — one per model family — appending one JSON
+line per run to runs/convergence/results.jsonl and full stdout to
+runs/convergence/<name>.log.
+
+Run it in the background on the build box:
+  nohup python tools/convergence_suite.py > runs/convergence/suite.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(ROOT, ".data", "digits")
+OUT = os.path.join(ROOT, "runs", "convergence")
+
+ENV = dict(os.environ)
+ENV.pop("PALLAS_AXON_POOL_IPS", None)   # CPU runs must not touch the
+ENV.pop("AXON_LOOPBACK_RELAY", None)    # (possibly wedged) TPU tunnel
+ENV["DLTPU_PLATFORM"] = "cpu"
+ENV["JAX_PLATFORMS"] = "cpu"
+
+RUNS = [
+    # (name, argv) — model families per VERDICT #5 + the MoE curve (#10)
+    ("vit_b16_cls_hard", [
+        "tools/train.py", "model.name=vit_base_patch16_224",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=12",
+        "optim.name=adamw", "optim.lr=0.001", "optim.weight_decay=0.05",
+        "optim.warmup_steps=200", f"train.workdir={OUT}/vit_b16"]),
+    ("swin_moe_cls_hard56", [
+        "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard56/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=12",
+        "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/swin_moe"]),
+    ("resnet50_cls_hard", [
+        "tools/train.py", "model.name=resnet50",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=6",
+        "optim.name=sgd", "optim.lr=0.05", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/resnet50"]),
+    ("yolox_tiny_det_hard", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "train.steps=1200", "train.lr=0.001"]),
+    ("yolox_tiny_det_hard_mosaic", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "data.mosaic=true",
+        "data.random_perspective=true", "data.degrees=5",
+        "train.steps=1200", "train.lr=0.001"]),
+    ("fasterrcnn_r18_det_hard", [
+        "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "train.steps=1200", "train.lr=0.0005"]),
+    ("hrnet_w18_seg_hard", [
+        "tools/train_task.py", "--task", "segmentation",
+        "model.name=hrnet_w18_seg", "model.num_classes=11",
+        f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
+        "train.steps=1500", "train.lr=0.001"]),
+]
+
+
+def ensure_datasets() -> None:
+    from tools.make_digits import (make_cls_hard, make_det_hard,
+                                   make_seg_hard)
+    jobs = [
+        (f"{DATA}/cls_hard/cls_hard.npz",
+         lambda: make_cls_hard(f"{DATA}/cls_hard", n_images=12000)),
+        (f"{DATA}/cls_hard56/cls_hard.npz",
+         lambda: make_cls_hard(f"{DATA}/cls_hard56", n_images=8000,
+                               size=56, seed=1)),
+        (f"{DATA}/det_hard/instances.json",
+         lambda: make_det_hard(f"{DATA}/det_hard", n_images=4000)),
+        (f"{DATA}/seg_hard/seg_hard.npz",
+         lambda: make_seg_hard(f"{DATA}/seg_hard", n_images=3000)),
+    ]
+    for path, make in jobs:
+        if os.path.exists(path):
+            print(f"dataset ok: {path}")
+        else:
+            t0 = time.time()
+            make()
+            print(f"generated {path} in {time.time() - t0:.0f}s")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="comma-separated run-name substrings")
+    ap.add_argument("--timeout", type=float, default=7200,
+                    help="per-run wall clock cap (s)")
+    args = ap.parse_args(argv)
+    os.makedirs(OUT, exist_ok=True)
+    sys.path.insert(0, ROOT)
+    ensure_datasets()
+
+    results_path = os.path.join(OUT, "results.jsonl")
+    done = set()
+    if os.path.exists(results_path):
+        with open(results_path) as f:
+            done = {json.loads(line)["name"] for line in f if line.strip()}
+    for name, cmd in RUNS:
+        if args.only and not any(tok in name
+                                 for tok in args.only.split(",")):
+            continue
+        if name in done:
+            print(f"skip {name} (already in results.jsonl)")
+            continue
+        log_path = os.path.join(OUT, f"{name}.log")
+        print(f"=== {name}: {' '.join(cmd)}")
+        t0 = time.time()
+        with open(log_path, "w") as log:
+            try:
+                rc = subprocess.run(
+                    [sys.executable] + cmd, cwd=ROOT, env=ENV,
+                    stdout=log, stderr=subprocess.STDOUT,
+                    timeout=args.timeout).returncode
+            except subprocess.TimeoutExpired:
+                rc = -9
+        tail = ""
+        try:
+            with open(log_path) as f:
+                lines = [l.strip() for l in f.read().splitlines()
+                         if l.strip()]
+            tail = lines[-1] if lines else ""
+        except OSError:
+            pass
+        entry = {"name": name, "rc": rc,
+                 "minutes": round((time.time() - t0) / 60, 1),
+                 "final": tail, "cmd": " ".join(cmd)}
+        with open(results_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        print(json.dumps(entry))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

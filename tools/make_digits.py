@@ -150,11 +150,153 @@ def make_kp(root: str, n_images: int = 300, canvas: int = 128,
     return n_images
 
 
+def _clutter(bg: np.ndarray, rng, n: int) -> None:
+    """Unlabeled distractors: bright strokes/blobs/ring fragments that a
+    lazy detector confuses with digit ink (occlusion + hard negatives)."""
+    canvas = bg.shape[0]
+    for _ in range(n):
+        kind = rng.integers(0, 3)
+        if kind == 0:        # line stroke
+            x0, y0 = rng.integers(0, canvas, 2)
+            ang = rng.uniform(0, 2 * np.pi)
+            length = int(rng.integers(canvas // 8, canvas // 2))
+            ts = np.arange(length)
+            xs = (x0 + ts * np.cos(ang)).astype(int) % canvas
+            ys = (y0 + ts * np.sin(ang)).astype(int) % canvas
+            val = rng.uniform(120, 230)
+            for d in (-1, 0, 1):
+                bg[np.clip(ys + d, 0, canvas - 1), xs] = np.maximum(
+                    bg[np.clip(ys + d, 0, canvas - 1), xs], val)
+        elif kind == 1:      # gaussian blob
+            cx, cy = rng.integers(8, canvas - 8, 2)
+            sig = rng.uniform(2, 6)
+            yy, xx = np.mgrid[0:canvas, 0:canvas]
+            blob = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2)
+                          / (2 * sig ** 2)) * rng.uniform(90, 200)
+            np.maximum(bg, blob, out=bg)
+        else:                # ring fragment
+            cx, cy = rng.integers(10, canvas - 10, 2)
+            r = rng.uniform(canvas // 16, canvas // 5)
+            a0 = rng.uniform(0, 2 * np.pi)
+            ts = np.linspace(a0, a0 + rng.uniform(1, 5), 80)
+            xs = np.clip((cx + r * np.cos(ts)).astype(int), 0, canvas - 1)
+            ys = np.clip((cy + r * np.sin(ts)).astype(int), 0, canvas - 1)
+            bg[ys, xs] = np.maximum(bg[ys, xs], rng.uniform(120, 220))
+
+
+def _affine_digit(imgs, j, side, rng):
+    """Digit scan -> side x side patch with random rotation/shear."""
+    from PIL import Image
+    pil = Image.fromarray(imgs[j], "L").resize((side, side), Image.BICUBIC)
+    pil = pil.rotate(float(rng.uniform(-25, 25)), resample=Image.BICUBIC,
+                     fillcolor=0)
+    return np.asarray(pil, np.float32)
+
+
+def make_cls_hard(root: str, n_images: int = 12000, size: int = 64,
+                  seed: int = 0) -> int:
+    """100-class hard classification: ordered digit PAIRS (class =
+    10*left + right) composited with rotation, scale jitter, textured
+    background and clutter — the offline proxy for many-class
+    classification (VERDICT r3 #5: >=50 classes, clutter, 10-20k
+    images). One npz: images (N, size, size, 1) uint8 + labels."""
+    imgs, labels = load_digits_images()
+    by_class = [np.flatnonzero(labels == c) for c in range(10)]
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    xs = np.zeros((n_images, size, size, 1), np.uint8)
+    ys = np.zeros((n_images,), np.int64)
+    for i in range(n_images):
+        cls = int(rng.integers(0, 100))
+        left, right = cls // 10, cls % 10
+        bg = rng.normal(80, 26, (size, size)).clip(0, 255)
+        _clutter(bg, rng, int(rng.integers(1, 4)))
+        for k, digit_cls in enumerate((left, right)):
+            side = int(rng.integers(size // 3, size // 2))
+            j = int(by_class[digit_cls][rng.integers(
+                0, len(by_class[digit_cls]))])
+            patch = _affine_digit(imgs, j, side, rng)
+            cx = int(rng.integers(0, size // 2 - side // 2)) if k == 0                 else int(rng.integers(size // 2, size - side))
+            cy = int(rng.integers(0, size - side))
+            region = bg[cy:cy + side, cx:cx + side]
+            np.maximum(region, patch, out=region)
+        xs[i, :, :, 0] = bg.astype(np.uint8)
+        ys[i] = cls
+    np.savez_compressed(os.path.join(root, "cls_hard.npz"),
+                        images=xs, labels=ys)
+    return n_images
+
+
+def make_det_hard(root: str, n_images: int = 4000, canvas: int = 128,
+                  max_obj: int = 8, seed: int = 0) -> int:
+    """Harder detection: up to ``max_obj`` digits per 128px scene, wide
+    scale range, rotations, heavy clutter, overlaps allowed."""
+    from PIL import Image
+    imgs, labels = load_digits_images()
+    rng = np.random.default_rng(seed)
+    img_dir = os.path.join(root, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    coco = {"images": [], "annotations": [],
+            "categories": [{"id": c + 1, "name": str(c)}
+                           for c in range(10)]}
+    ann_id = 1
+    for img_id in range(n_images):
+        bg = rng.normal(84, 26, (canvas, canvas)).clip(0, 255)
+        _clutter(bg, rng, int(rng.integers(2, 6)))
+        for _ in range(int(rng.integers(1, max_obj + 1))):
+            side = int(rng.integers(14, 52))
+            j = int(rng.integers(0, len(imgs)))
+            patch = _affine_digit(imgs, j, side, rng)
+            x0 = int(rng.integers(0, canvas - side))
+            y0 = int(rng.integers(0, canvas - side))
+            region = bg[y0:y0 + side, x0:x0 + side]
+            np.maximum(region, patch, out=region)
+            coco["annotations"].append({
+                "id": ann_id, "image_id": img_id,
+                "category_id": int(labels[j]) + 1,
+                "bbox": [x0, y0, side, side],
+                "area": side * side, "iscrowd": 0})
+            ann_id += 1
+        fname = f"det_{img_id:05d}.jpg"
+        Image.fromarray(bg.astype(np.uint8), "L").convert("RGB").save(
+            os.path.join(img_dir, fname), quality=90)
+        coco["images"].append({"id": img_id, "file_name": fname,
+                               "width": canvas, "height": canvas})
+    with open(os.path.join(root, "instances.json"), "w") as f:
+        json.dump(coco, f)
+    return n_images
+
+
+def make_seg_hard(root: str, n_images: int = 3000, canvas: int = 128,
+                  max_obj: int = 6, seed: int = 0) -> int:
+    """Harder 11-class segmentation: more objects + clutter distractors
+    that stay background-labeled."""
+    imgs, labels = load_digits_images()
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    xs = np.zeros((n_images, canvas, canvas), np.uint8)
+    ys = np.zeros((n_images, canvas, canvas), np.uint8)
+    for img_id in range(n_images):
+        bg = rng.normal(84, 26, (canvas, canvas)).clip(0, 255)
+        _clutter(bg, rng, int(rng.integers(2, 5)))
+        mask = np.zeros((canvas, canvas), np.uint8)
+        for _ in range(int(rng.integers(1, max_obj + 1))):
+            x0, y0, side, cls, won = _paste_digit(bg, imgs, labels, rng,
+                                                  (16, 52))
+            mask[y0:y0 + side, x0:x0 + side][won] = cls + 1
+        xs[img_id] = bg.astype(np.uint8)
+        ys[img_id] = mask
+    np.savez_compressed(os.path.join(root, "seg_hard.npz"),
+                        images=xs, masks=ys)
+    return n_images
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default=".data/digits")
     ap.add_argument("--which", default="both",
-                    choices=["cls", "det", "seg", "kp", "both", "all"])
+                    choices=["cls", "det", "seg", "kp", "both", "all",
+                             "hard"])
     ap.add_argument("--det-images", type=int, default=800)
     ap.add_argument("--seg-images", type=int, default=400)
     ap.add_argument("--kp-images", type=int, default=300)
@@ -170,6 +312,15 @@ def main():
         n = make_seg(os.path.join(args.root, "seg"),
                      n_images=args.seg_images)
         print(f"seg: wrote {n} scenes+masks to {args.root}/seg/seg.npz")
+    if args.which == "hard":
+        n = make_cls_hard(os.path.join(args.root, "cls_hard"))
+        print(f"cls_hard: {n} images -> {args.root}/cls_hard/cls_hard.npz")
+        n = make_det_hard(os.path.join(args.root, "det_hard"),
+                          n_images=args.det_images)
+        print(f"det_hard: {n} scenes -> {args.root}/det_hard/")
+        n = make_seg_hard(os.path.join(args.root, "seg_hard"),
+                          n_images=args.seg_images)
+        print(f"seg_hard: {n} scenes -> {args.root}/seg_hard/seg_hard.npz")
     if args.which in ("kp", "all"):
         n = make_kp(os.path.join(args.root, "kp"),
                     n_images=args.kp_images)

@@ -95,6 +95,9 @@ def load_data(cfg: DataCfg, num_classes: int
               ) -> Tuple[np.ndarray, np.ndarray]:
     if cfg.npz:
         blob = np.load(cfg.npz)
+        # raw storage (often uint8 single-channel); conversion to model
+        # f32/RGB happens per-sample in the loader source, NOT here — an
+        # eager convert would hold a 12x float copy of the whole dataset
         return blob["images"], blob["labels"]
     rng = np.random.default_rng(0)
     n, s, c = cfg.n_train, cfg.image_size, cfg.channels
@@ -160,8 +163,25 @@ def main(argv=None) -> int:
         n_train = len(loader) * cfg.data.global_batch
     else:
         images, labels = load_data(cfg.data, cfg.model.num_classes)
-        sample_shape = (1,) + images.shape[1:]
-        n_train = len(images)
+        hw = images.shape[1:3]
+        sample_shape = (1, hw[0], hw[1], cfg.data.channels)
+        tr_images, tr_labels = images, labels
+        ev_images, ev_labels = images, labels
+        gb = cfg.data.global_batch
+        if cfg.data.npz and cfg.data.val_rate > 0 and len(images) >= 2 * gb:
+            # held-out split for npz datasets, BEFORE the schedule is
+            # sized (total_steps must match the post-split loader) and
+            # never smaller than one eval batch (the loader floor-divides,
+            # so a sub-batch slice would silently eval nothing)
+            order = np.random.default_rng(cfg.train.seed).permutation(
+                len(images))
+            n_val = min(max(int(len(images) * cfg.data.val_rate), gb),
+                        len(images) - gb)
+            ev_images, ev_labels = (images[order[:n_val]],
+                                    labels[order[:n_val]])
+            tr_images, tr_labels = (images[order[n_val:]],
+                                    labels[order[n_val:]])
+        n_train = len(tr_images)
     dtype = jnp.bfloat16 if cfg.model.precision == "bf16" else jnp.float32
     model_kw = {}
     if cfg.train.seq_parallel not in ("ring", "ulysses"):
@@ -214,10 +234,31 @@ def main(argv=None) -> int:
         state = shard_state(state, mesh)
     has_bn = bool(variables.get("batch_stats"))
     if not cfg.data.folder:
-        loader = DataLoader(ArraySource(image=images, label=labels),
+        def _cls_source(imgs, labs):
+            """Per-sample uint8→f32 + channel expansion (lazy, so the
+            dataset stays in its compact storage dtype in RAM)."""
+            needs = (imgs.dtype == np.uint8 or imgs.ndim == 3
+                     or imgs.shape[-1] != cfg.data.channels)
+            if not needs:
+                return ArraySource(image=imgs, label=labs)
+            from deeplearning_tpu.data.loader import MapSource
+
+            def fetch(i):
+                img = imgs[i]
+                if img.dtype == np.uint8:
+                    img = img.astype(np.float32) / 255.0
+                if img.ndim == 2:
+                    img = img[..., None]
+                if img.shape[-1] == 1 and cfg.data.channels == 3:
+                    img = np.repeat(img, 3, axis=-1)
+                return {"image": np.asarray(img, np.float32),
+                        "label": labs[i]}
+            return MapSource(len(imgs), fetch)
+
+        loader = DataLoader(_cls_source(tr_images, tr_labels),
                             global_batch=cfg.data.global_batch, mesh=mesh,
                             seed=cfg.train.seed)
-        eval_loader = DataLoader(ArraySource(image=images, label=labels),
+        eval_loader = DataLoader(_cls_source(ev_images, ev_labels),
                                  global_batch=cfg.data.global_batch,
                                  mesh=mesh, shuffle=False)
     if cfg.data.global_batch % max(cfg.train.accum_steps, 1):
