@@ -178,7 +178,12 @@ class VisionTransformer(nn.Module):
         pos = self.param("pos_embed",
                          nn.initializers.truncated_normal(0.02),
                          (1, n + 1, c), jnp.float32)
-        x = x + pos.astype(x.dtype)
+        # explicit broadcast: its transpose is ONE reduce_sum over batch,
+        # which GSPMD shards cleanly; the implicit-broadcast add's
+        # transpose accumulated pos grads through an add_any chain whose
+        # chosen sharding forced an involuntary full rematerialization
+        # under data x fsdp meshes (MULTICHIP r3 tail warnings)
+        x = x + jnp.broadcast_to(pos.astype(x.dtype), x.shape)
         x = nn.Dropout(self.drop_rate, deterministic=deterministic)(x)
 
         import numpy as np

@@ -90,8 +90,21 @@ TRANSFORMER_TP_RULES: Rules = (
     (r"(qkv|query|key|value|mlp/fc1|Dense_0)/bias$", P(MODEL_AXIS)),
 )
 
-# FSDP rules: shard every large matmul kernel's output dim over fsdp.
+# FSDP rules: shard matmul kernels over fsdp. Row-parallel kernels
+# (attention proj, mlp/fc2 — the second matmul of each pair) shard their
+# INPUT dim, everything else the output dim: with all kernels
+# output-sharded, the backward kernel-grad dots need the batch-sharded
+# activation cotangent resharded to feature sharding, which the SPMD
+# partitioner can only do by full rematerialization ("Involuntary full
+# rematerialization" warnings, MULTICHIP r3); the alternating layout
+# keeps every grad contraction layout-compatible (and shards the WIDE
+# dim of fc2, which is bigger anyway).
 FSDP_RULES: Rules = (
+    # anchored to the transformer paths (blocks_*/attn/proj,
+    # stage*_block*/attn/proj, */mlp/fc2) so 4-D conv kernels that happen
+    # to be NAMED proj (ViT patch_embed/proj and friends) stay on the
+    # output-dim rule instead of sharding a tiny spatial dim
+    (r"(attn/proj|mlp/fc2)/kernel$", P(FSDP_AXIS, None)),
     (r"kernel$", P(None, FSDP_AXIS)),
 )
 

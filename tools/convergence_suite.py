@@ -8,7 +8,8 @@ line per run to runs/convergence/results.jsonl and full stdout to
 runs/convergence/<name>.log.
 
 Run it in the background on the build box:
-  nohup python tools/convergence_suite.py > runs/convergence/suite.log 2>&1 &
+  mkdir -p runs/convergence && \\
+    nohup python tools/convergence_suite.py > runs/convergence/suite.log 2>&1 &
 """
 
 from __future__ import annotations
@@ -81,19 +82,29 @@ RUNS = [
 def ensure_datasets() -> None:
     from tools.make_digits import (make_cls_hard, make_det_hard,
                                    make_seg_hard)
+    def npz_count(path):
+        import numpy as np
+        return len(np.load(path)["images"])
+
+    def json_count(path):
+        with open(path) as f:
+            return len(json.load(f)["images"])
+
     jobs = [
-        (f"{DATA}/cls_hard/cls_hard.npz",
+        (f"{DATA}/cls_hard/cls_hard.npz", npz_count, 12000,
          lambda: make_cls_hard(f"{DATA}/cls_hard", n_images=12000)),
-        (f"{DATA}/cls_hard56/cls_hard.npz",
+        (f"{DATA}/cls_hard56/cls_hard.npz", npz_count, 8000,
          lambda: make_cls_hard(f"{DATA}/cls_hard56", n_images=8000,
                                size=56, seed=1)),
-        (f"{DATA}/det_hard/instances.json",
+        (f"{DATA}/det_hard/instances.json", json_count, 4000,
          lambda: make_det_hard(f"{DATA}/det_hard", n_images=4000)),
-        (f"{DATA}/seg_hard/seg_hard.npz",
+        (f"{DATA}/seg_hard/seg_hard.npz", npz_count, 3000,
          lambda: make_seg_hard(f"{DATA}/seg_hard", n_images=3000)),
     ]
-    for path, make in jobs:
-        if os.path.exists(path):
+    for path, count, want, make in jobs:
+        # size check, not just existence: a dataset generated earlier
+        # with different parameters would silently skew the results
+        if os.path.exists(path) and count(path) == want:
             print(f"dataset ok: {path}")
         else:
             t0 = time.time()
@@ -116,7 +127,8 @@ def main(argv=None) -> int:
     done = set()
     if os.path.exists(results_path):
         with open(results_path) as f:
-            done = {json.loads(line)["name"] for line in f if line.strip()}
+            done = {e["name"] for e in map(json.loads, f)
+                    if isinstance(e, dict) and e.get("rc") == 0}
     for name, cmd in RUNS:
         if args.only and not any(tok in name
                                  for tok in args.only.split(",")):
