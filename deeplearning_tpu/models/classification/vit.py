@@ -217,7 +217,12 @@ def _factory(name, **defaults):
     return build
 
 
-# Factories mirror vit_model.py:290-358.
+# Factories mirror vit_model.py:290-358 (+ the timm-standard small
+# config the reference file derives from, used by the offline
+# convergence runs).
+vit_small_patch16_224 = _factory("vit_small_patch16_224",
+                                 patch_size=16, embed_dim=384, depth=12,
+                                 num_heads=6)
 vit_base_patch16_224 = _factory("vit_base_patch16_224",
                                 patch_size=16, embed_dim=768, depth=12,
                                 num_heads=12)

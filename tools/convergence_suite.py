@@ -33,49 +33,49 @@ ENV["JAX_PLATFORMS"] = "cpu"
 
 RUNS = [
     # (name, argv) — model families per VERDICT #5 + the MoE curve (#10)
-    ("vit_b16_cls_hard", [
-        "tools/train.py", "model.name=vit_base_patch16_224",
+    ("vit_s16_cls_hard", [
+        "tools/train.py", "model.name=vit_small_patch16_224",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=12",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=6",
         "optim.name=adamw", "optim.lr=0.001", "optim.weight_decay=0.05",
-        "optim.warmup_steps=200", f"train.workdir={OUT}/vit_b16"]),
+        "optim.warmup_steps=150", f"train.workdir={OUT}/vit_s16"]),
     ("swin_moe_cls_hard56", [
         "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard56/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=12",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=8",
         "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
         f"train.workdir={OUT}/swin_moe"]),
     ("resnet50_cls_hard", [
         "tools/train.py", "model.name=resnet50",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=6",
+        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=3",
         "optim.name=sgd", "optim.lr=0.05", "optim.warmup_steps=100",
         f"train.workdir={OUT}/resnet50"]),
     ("yolox_tiny_det_hard", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=1200", "train.lr=0.001"]),
+        "data.max_gt=8", "train.steps=1000", "train.lr=0.001"]),
     ("yolox_tiny_det_hard_mosaic", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
         "data.max_gt=8", "data.mosaic=true",
         "data.random_perspective=true", "data.degrees=5",
-        "train.steps=1200", "train.lr=0.001"]),
+        "train.steps=1000", "train.lr=0.001"]),
     ("fasterrcnn_r18_det_hard", [
         "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=1200", "train.lr=0.0005"]),
+        "data.max_gt=8", "train.steps=1000", "train.lr=0.0005"]),
     ("hrnet_w18_seg_hard", [
         "tools/train_task.py", "--task", "segmentation",
         "model.name=hrnet_w18_seg", "model.num_classes=11",
         f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
-        "train.steps=1500", "train.lr=0.001"]),
+        "train.steps=800", "train.lr=0.001"]),
 ]
 
 
