@@ -13,6 +13,9 @@ Covered reference surfaces:
 - classification/swin_transformer/models/swin_transformer.py:70
 - detection/yolov5/models/common.py                   Focus/Conv/C3/SPP
 - deep_stereo/.../models/MadNet.py                    Pyramid_Encoder
+- detection/RetinaNet/network_files/losses.py         sigmoid_focal_loss
+- detection/yolov5/utils/metrics.py                   bbox_iou (G/D/CIoU)
+- classification/RepVGG/models/repvgg.py              RepVGG train form
 """
 
 import contextlib
@@ -342,3 +345,93 @@ def test_madnet_pyramid_parity():
     assert len(got) == 6
     for g, w in zip(got, want):
         _assert_close(g, w)
+
+
+# -------------------------------------------------------- loss functions
+
+def test_focal_loss_parity():
+    """RetinaNet sigmoid focal loss vs the reference's fvcore port
+    (network_files/losses.py:5)."""
+    with _isolated_imports():
+        ref = _load_by_path(
+            "ref_retina_losses",
+            REF / "detection/RetinaNet/network_files/losses.py")
+        rng = np.random.default_rng(0)
+        logits = rng.normal(0, 2, (64, 9)).astype("f4")
+        targets = (rng.uniform(size=(64, 9)) < 0.3).astype("f4")
+        want = ref.sigmoid_focal_loss(
+            torch.from_numpy(logits), torch.from_numpy(targets),
+            alpha=0.25, gamma=2, reduction="none").numpy()
+
+    from deeplearning_tpu.ops.losses import sigmoid_focal_loss
+    got = sigmoid_focal_loss(jnp.asarray(logits), jnp.asarray(targets),
+                             alpha=0.25, gamma=2.0, reduction="none")
+    _assert_close(got, want, tol=1e-5)
+
+
+def test_bbox_iou_parity():
+    """GIoU/DIoU/CIoU vs yolov5's bbox_iou (utils/metrics.py:239), the
+    function behind the CIoU box loss."""
+    mpl = types.ModuleType("matplotlib")
+    mpl.pyplot = types.ModuleType("matplotlib.pyplot")
+    with _isolated_imports(stubs={"matplotlib": mpl,
+                                  "matplotlib.pyplot": mpl.pyplot}):
+        ref = _load_by_path("ref_y5_metrics",
+                            REF / "detection/yolov5/utils/metrics.py")
+        rng = np.random.default_rng(1)
+        xy1 = rng.uniform(0, 50, (32, 2))
+        wh1 = rng.uniform(5, 60, (32, 2))
+        xy2 = rng.uniform(0, 50, (32, 2))
+        wh2 = rng.uniform(5, 60, (32, 2))
+        b1 = np.concatenate([xy1, xy1 + wh1], 1).astype("f4")
+        b2 = np.concatenate([xy2, xy2 + wh2], 1).astype("f4")
+        want = {}
+        for kind, kw in [("iou", {}), ("giou", {"GIoU": True}),
+                         ("diou", {"DIoU": True}),
+                         ("ciou", {"CIoU": True})]:
+            want[kind] = ref.bbox_iou(
+                torch.from_numpy(b1).T, torch.from_numpy(b2),
+                x1y1x2y2=True, **kw).numpy()
+
+    from deeplearning_tpu.ops.boxes import elementwise_box_iou
+    for kind, w in want.items():
+        got = elementwise_box_iou(jnp.asarray(b1), jnp.asarray(b2),
+                                  kind=kind)
+        _assert_close(got, w.reshape(got.shape), tol=2e-4)
+
+
+def test_repvgg_forward_parity():
+    """RepVGG-A0 train-form forward (3x3+1x1+identity branches) vs the
+    reference (classification/RepVGG/models/repvgg.py)."""
+    # repvgg.py does `from models.se_block import SEBlock` with the
+    # project dir as root
+    with _isolated_imports(
+            extra_sys_path=[REF / "classification/RepVGG"]):
+        ref = _load_by_path("ref_repvgg",
+                            REF / "classification/RepVGG/models/repvgg.py")
+        torch.manual_seed(0)
+        net = ref.RepVGG(num_blocks=[1, 1, 1, 1], num_classes=7,
+                         width_multiplier=[0.25, 0.25, 0.25, 0.5])
+        _randomize_torch(net)
+        x = np.random.default_rng(5).normal(size=(2, 64, 64, 3)) \
+            .astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy()
+
+    def rename(stem):
+        stem = re.sub(r"stage(\d+)\.(\d+)", r"stage\1_block\2", stem)
+        stem = stem.replace("rbr_dense.conv", "dense3")
+        stem = stem.replace("rbr_dense.bn", "bn3")
+        stem = stem.replace("rbr_1x1.conv", "dense1")
+        stem = stem.replace("rbr_1x1.bn", "bn1")
+        stem = stem.replace("rbr_identity", "bnid")
+        stem = stem.replace("linear", "fc")
+        return stem
+
+    variables = _port(net, rename)
+    from deeplearning_tpu.models.classification.repvgg import RepVGG
+    model = RepVGG(num_blocks=(1, 1, 1, 1),
+                   width_mult=(0.25, 0.25, 0.25, 0.5), num_classes=7,
+                   dtype=jnp.float32)
+    got = model.apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
