@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Render the convergence-suite results as a markdown table.
+
+Reads runs/convergence/results.jsonl (+ per-run workdir CSVs for the
+classification learning curves) and prints the README table. Run after
+tools/convergence_suite.py finishes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "runs", "convergence")
+
+
+def curve_from_log(log_path: str):
+    """[(epoch, top1), ...] scraped from the Trainer's eval log lines."""
+    if not os.path.exists(log_path):
+        return []
+    rows = []
+    pat = re.compile(r"eval @ epoch (\d+):.*top1=([0-9.]+)")
+    for line in open(log_path):
+        m = pat.search(line)
+        if m:
+            rows.append((int(m.group(1)), float(m.group(2))))
+    # the trainer logs each eval twice (console + file tee); dedupe
+    return sorted(set(rows))
+
+
+def main() -> int:
+    results_path = os.path.join(OUT, "results.jsonl")
+    if not os.path.exists(results_path):
+        print("no results.jsonl yet")
+        return 1
+    entries = [json.loads(l) for l in open(results_path) if l.strip()]
+    print("| run | minutes | final metrics |")
+    print("|---|---|---|")
+    for e in entries:
+        final = e["final"]
+        m = re.search(r"\{.*\}", final)
+        if m:
+            final = m.group(0)
+        print(f"| {e['name']} | {e['minutes']} | `{final[:160]}` |")
+    for e in entries:
+        curve = curve_from_log(os.path.join(OUT, f"{e['name']}.log"))
+        if curve:
+            pts = "  ".join(f"{ep}:{v:.3f}" for ep, v in curve)
+            print(f"\n{e['name']} val-top1 curve: {pts}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,13 +33,6 @@ ENV["JAX_PLATFORMS"] = "cpu"
 
 RUNS = [
     # (name, argv) — model families per VERDICT #5 + the MoE curve (#10)
-    ("vit_s16_cls_hard", [
-        "tools/train.py", "model.name=vit_small_patch16_224",
-        "model.num_classes=100", "model.precision=f32",
-        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=6",
-        "optim.name=adamw", "optim.lr=0.001", "optim.weight_decay=0.05",
-        "optim.warmup_steps=150", f"train.workdir={OUT}/vit_s16"]),
     ("swin_moe_cls_hard56", [
         "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
         "model.num_classes=100", "model.precision=f32",
@@ -76,6 +69,14 @@ RUNS = [
         "model.name=hrnet_w18_seg", "model.num_classes=11",
         f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
         "train.steps=800", "train.lr=0.001"]),
+    ("vit_s16_cls_hard", [
+        "tools/train.py", "model.name=vit_small_patch16_224",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=10",
+        "train.label_smoothing=0.1", "optim.name=adamw",
+        "optim.lr=0.002", "optim.weight_decay=0.05",
+        "optim.warmup_steps=300", f"train.workdir={OUT}/vit_s16"]),
 ]
 
 
