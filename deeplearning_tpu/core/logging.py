@@ -15,7 +15,7 @@ import os
 import sys
 import time
 from collections import defaultdict, deque
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Dict, Iterable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -197,10 +197,19 @@ class CsvLogger:
             if self._columns is None:
                 self._columns = list(row)
                 write_header = True
-        extra = set(row) - set(self._columns)
+        extra = [k for k in row if k not in self._columns]
         if extra:
-            create_logger().warning(
-                f"CsvLogger: ignoring new columns {sorted(extra)}")
+            # extend the header in place (train/* rows come first, eval/*
+            # appears later — dropping them would lose eval metrics):
+            # rewrite the small file with the widened column set
+            with open(self._path, newline="") as f:
+                rows = list(csv.DictReader(f))
+            self._columns = self._columns + extra
+            with open(self._path, "w", newline="") as f:
+                w = csv.DictWriter(f, self._columns)
+                w.writeheader()
+                w.writerows(rows)
+            write_header = False
         with open(self._path, "a", newline="") as f:
             w = csv.DictWriter(f, self._columns, extrasaction="ignore")
             if write_header:
@@ -209,7 +218,99 @@ class CsvLogger:
 
 
 def _scalar(v: Any) -> Any:
+    if isinstance(v, bool):        # bools are metadata flags, not metrics
+        return v
     try:
         return float(v)
     except (TypeError, ValueError):
         return v
+
+
+# ---------------------------------------------------------------------------
+# Pluggable logger backends — the yolov5 Loggers shape
+# (utils/loggers/__init__.py:17-27: csv / TensorBoard / W&B behind one
+# object). The W&B slot is an OFFLINE JSONL sink (this image has no
+# network); its record structure mirrors a wandb offline run: one JSON
+# object per log call with step + wall time + metrics, plus a final
+# summary record.
+# ---------------------------------------------------------------------------
+
+from .registry import Registry
+
+LOGGERS = Registry("loggers")
+
+
+class JsonlLogger:
+    """Offline W&B-style sink: runs/<dir>/metrics.jsonl."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path if (path and is_main_process()) else None
+
+    def log(self, step: int, metrics: Dict[str, Any]) -> None:
+        if self._path is None:
+            return
+        import json
+        import os
+        import time
+        os.makedirs(os.path.dirname(os.path.abspath(self._path)),
+                    exist_ok=True)
+        rec = {"step": int(step), "time": time.time(),
+               **{k: _scalar(v) for k, v in metrics.items()}}
+        with open(self._path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def summary(self, results: Dict[str, Any]) -> None:
+        self.log(-1, {"summary": True, **results})
+
+
+@LOGGERS.register("tensorboard")
+def _tb_backend(workdir: str):
+    return TensorBoardWriter(workdir)
+
+
+@LOGGERS.register("csv")
+def _csv_backend(workdir: str):
+    import os
+    return CsvLogger(os.path.join(workdir, "results.csv"))
+
+
+@LOGGERS.register("jsonl")
+def _jsonl_backend(workdir: str):
+    import os
+    return JsonlLogger(os.path.join(workdir, "metrics.jsonl"))
+
+
+class LoggerHub:
+    """One dispatch point over the selected backends (the Loggers class
+    analog). Unknown backend names fail loudly at construction — the
+    reference prints and drops, which hides config typos."""
+
+    def __init__(self, workdir: Optional[str],
+                 backends: Sequence[str] = ("tensorboard", "csv",
+                                            "jsonl")):
+        self.workdir = workdir
+        self.backends: Dict[str, Any] = {}
+        if workdir:
+            for name in backends:
+                self.backends[name] = LOGGERS.build(name, workdir)
+
+    @property
+    def tb(self) -> "TensorBoardWriter":
+        return self.backends.get("tensorboard") or TensorBoardWriter(None)
+
+    def scalars(self, metrics: Dict[str, Any], step: int) -> None:
+        for name, backend in self.backends.items():
+            if isinstance(backend, TensorBoardWriter):
+                backend.add_scalars(metrics, step)
+            else:
+                backend.log(step, metrics)
+
+    def summary(self, results: Dict[str, Any]) -> None:
+        for backend in self.backends.values():
+            if hasattr(backend, "summary"):
+                backend.summary(results)
+
+    def close(self) -> None:
+        for backend in self.backends.values():
+            if hasattr(backend, "close"):
+                backend.close()

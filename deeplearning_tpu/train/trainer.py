@@ -24,7 +24,8 @@ import numpy as np
 
 from ..core import rng as rng_mod
 from ..core.checkpoint import CheckpointManager
-from ..core.logging import (MetricLogger, TensorBoardWriter, create_logger,
+from ..core.logging import (LoggerHub, MetricLogger,
+                            TensorBoardWriter, create_logger,
                             is_main_process)
 
 HOOKS = ("before_train", "after_train", "before_epoch", "after_epoch",
@@ -66,6 +67,7 @@ class Trainer:
         metric_reducer: Optional[Callable[[Dict], Dict]] = None,
         abort_non_finite: bool = True,
         async_checkpoint: bool = False,
+        log_backends=("tensorboard", "csv", "jsonl"),
     ):
         self.state = state
         self.train_step = train_step
@@ -82,7 +84,11 @@ class Trainer:
         self.metric_reducer = metric_reducer
         self.abort_non_finite = abort_non_finite
         self.logger = create_logger("dltpu", workdir)
-        self.tb = TensorBoardWriter(workdir)
+        # pluggable backends (yolov5 Loggers shape): tensorboard + csv +
+        # offline-W&B jsonl by default; self.tb stays the TB handle for
+        # figures/images
+        self.hub = LoggerHub(workdir, log_backends)
+        self.tb = self.hub.tb
         self.meters = MetricLogger()
         self.rng = rng_mod.host_key(seed)
         self.epoch = 0
@@ -117,7 +123,10 @@ class Trainer:
             if self.ckpt:
                 self.ckpt.wait_until_finished()
         self.callbacks.fire("after_train", self)
-        self.tb.close()
+        self.hub.summary({"best_" + self.best_metric: self.best_value,
+                          "epochs": self.epoch,
+                          **getattr(self, "_last_eval", {})})
+        self.hub.close()
         return self.state
 
     def _train_one_epoch(self, epoch: int) -> None:
@@ -151,7 +160,7 @@ class Trainer:
                 self.logger.info(
                     f"epoch {epoch} it {it}/{len(self.train_loader)} "
                     f"{self.meters}")
-                self.tb.add_scalars(
+                self.hub.scalars(
                     {f"train/{k}": v for k, v in host.items()}, step)
             t_data = time.time()
 
@@ -168,12 +177,13 @@ class Trainer:
         elif "count" in totals and totals["count"] > 0:
             results = {k: v / totals["count"] for k, v in totals.items()
                        if k != "count"}
+        self._last_eval = dict(results)
         self.callbacks.fire("on_evaluate", self, results=results)
         self.logger.info(f"eval @ epoch {self.epoch}: "
                          + "  ".join(f"{k}={v:.4f}"
                                      for k, v in results.items()))
-        self.tb.add_scalars({f"eval/{k}": v for k, v in results.items()},
-                            int(self.state.step))
+        self.hub.scalars({f"eval/{k}": v for k, v in results.items()},
+                         int(self.state.step))
         value = results.get(self.best_metric)
         if value is not None and value > self.best_value:
             self.best_value = value

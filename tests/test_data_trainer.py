@@ -212,3 +212,32 @@ def test_parallel_loader_matches_serial():
         np.testing.assert_array_equal(a["x"], b["x"])
         np.testing.assert_array_equal(a["label"], b["label"])
     assert len(list(iter(pooled))) == len(serial)
+
+
+class TestLoggerHub:
+    """Pluggable logger backends (yolov5 utils/loggers/__init__.py:17-27
+    csv/TensorBoard/W&B trio; the W&B slot is the offline JSONL sink)."""
+
+    def test_backends_write(self, tmp_path):
+        import json
+
+        from deeplearning_tpu.core.logging import LoggerHub
+        hub = LoggerHub(str(tmp_path), ("csv", "jsonl"))
+        hub.scalars({"train/loss": 1.5, "train/acc": 0.5}, step=1)
+        hub.scalars({"train/loss": 1.0, "train/acc": 0.7}, step=2)
+        hub.summary({"top1": 0.9})
+        hub.close()
+        csv_lines = (tmp_path / "results.csv").read_text().splitlines()
+        assert csv_lines[0].startswith("step,")
+        assert len(csv_lines) == 3
+        recs = [json.loads(l) for l in
+                (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        assert recs[0]["step"] == 1 and recs[1]["train/acc"] == 0.7
+        assert recs[-1]["summary"] is True and recs[-1]["top1"] == 0.9
+
+    def test_unknown_backend_fails_loudly(self, tmp_path):
+        import pytest
+
+        from deeplearning_tpu.core.logging import LoggerHub
+        with pytest.raises(KeyError, match="wandb_online"):
+            LoggerHub(str(tmp_path), ("csv", "wandb_online"))

@@ -36,15 +36,22 @@ def main() -> int:
     if not os.path.exists(results_path):
         print("no results.jsonl yet")
         return 1
-    entries = [json.loads(l) for l in open(results_path) if l.strip()]
-    print("| run | minutes | final metrics |")
-    print("|---|---|---|")
+    raw = [json.loads(l) for l in open(results_path) if l.strip()]
+    latest = {}
+    for e in raw:                      # keep the LAST attempt per run
+        latest[e["name"]] = e
+    entries = list(latest.values())
+    print("| run | rc | minutes | final metrics |")
+    print("|---|---|---|---|")
     for e in entries:
         final = e["final"]
         m = re.search(r"\{.*\}", final)
         if m:
             final = m.group(0)
-        print(f"| {e['name']} | {e['minutes']} | `{final[:160]}` |")
+        elif e["rc"] != 0:
+            final = "(failed)"
+        print(f"| {e['name']} | {e['rc']} | {e['minutes']} "
+              f"| `{final[:160]}` |")
     for e in entries:
         curve = curve_from_log(os.path.join(OUT, f"{e['name']}.log"))
         if curve:

@@ -69,7 +69,7 @@ RUNS = [
         "model.name=hrnet_w18_seg", "model.num_classes=11",
         f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
         "train.steps=800", "train.lr=0.001"]),
-    ("vit_s16_cls_hard", [
+    ("vit_s16_cls_hard_v2", [
         "tools/train.py", "model.name=vit_small_patch16_224",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
