@@ -33,47 +33,49 @@ ENV["JAX_PLATFORMS"] = "cpu"
 
 RUNS = [
     # (name, argv) — model families per VERDICT #5 + the MoE curve (#10)
-    ("swin_moe_cls_hard56", [
+    # 28px/batch-16 keeps the dense dispatch einsum (O(T^2 d), an MXU
+    # shape, brutal on one CPU core) small enough to converge offline
+    ("swin_moe_cls_hard28_e10", [
         "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
         "model.num_classes=100", "model.precision=f32",
-        f"data.npz={DATA}/cls_hard56/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=8",
+        f"data.npz={DATA}/cls_hard28/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=16", "train.epochs=10",
         "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
         f"train.workdir={OUT}/swin_moe"]),
     ("resnet50_cls_hard", [
         "tools/train.py", "model.name=resnet50",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=3",
-        "optim.name=sgd", "optim.lr=0.05", "optim.warmup_steps=100",
+        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=2",
+        "optim.name=adamw", "optim.lr=0.001", "optim.warmup_steps=100",
         f"train.workdir={OUT}/resnet50"]),
     ("yolox_tiny_det_hard", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=1000", "train.lr=0.001"]),
+        "data.max_gt=8", "train.steps=700", "train.lr=0.001"]),
     ("yolox_tiny_det_hard_mosaic", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
         "data.max_gt=8", "data.mosaic=true",
         "data.random_perspective=true", "data.degrees=5",
-        "train.steps=1000", "train.lr=0.001"]),
+        "train.steps=500", "train.lr=0.001"]),
     ("fasterrcnn_r18_det_hard", [
         "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=1000", "train.lr=0.0005"]),
+        "data.max_gt=8", "train.steps=600", "train.lr=0.0005"]),
     ("hrnet_w18_seg_hard", [
         "tools/train_task.py", "--task", "segmentation",
         "model.name=hrnet_w18_seg", "model.num_classes=11",
         f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
-        "train.steps=800", "train.lr=0.001"]),
+        "train.steps=500", "train.lr=0.001"]),
     ("vit_s16_cls_hard_v2", [
         "tools/train.py", "model.name=vit_small_patch16_224",
         "model.num_classes=100", "model.precision=f32",
         f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=10",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=4",
         "train.label_smoothing=0.1", "optim.name=adamw",
         "optim.lr=0.002", "optim.weight_decay=0.05",
         "optim.warmup_steps=300", f"train.workdir={OUT}/vit_s16"]),
@@ -94,9 +96,9 @@ def ensure_datasets() -> None:
     jobs = [
         (f"{DATA}/cls_hard/cls_hard.npz", npz_count, 12000,
          lambda: make_cls_hard(f"{DATA}/cls_hard", n_images=12000)),
-        (f"{DATA}/cls_hard56/cls_hard.npz", npz_count, 8000,
-         lambda: make_cls_hard(f"{DATA}/cls_hard56", n_images=8000,
-                               size=56, seed=1)),
+        (f"{DATA}/cls_hard28/cls_hard.npz", npz_count, 4000,
+         lambda: make_cls_hard(f"{DATA}/cls_hard28", n_images=4000,
+                               size=28, seed=2)),
         (f"{DATA}/det_hard/instances.json", json_count, 4000,
          lambda: make_det_hard(f"{DATA}/det_hard", n_images=4000)),
         (f"{DATA}/seg_hard/seg_hard.npz", npz_count, 3000,
