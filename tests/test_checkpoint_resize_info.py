@@ -72,15 +72,17 @@ class TestModelInfo:
 
 
 class TestCsvLogger:
-    def test_roundtrip(self, tmp_path):
+    def test_roundtrip_widens_columns(self, tmp_path):
+        # new keys (e.g. eval/* appearing after train/*) widen the header
+        # in place instead of being dropped
         path = tmp_path / "results.csv"
         log = CsvLogger(str(path))
         log.log(1, {"loss": 2.0, "acc": 0.1})
         log.log(2, {"loss": 1.0, "acc": 0.5, "new_col": 9})
         lines = path.read_text().strip().splitlines()
-        assert lines[0] == "step,loss,acc"
-        assert lines[1] == "1,2.0,0.1"
-        assert lines[2].startswith("2,1.0,0.5")
+        assert lines[0] == "step,loss,acc,new_col"
+        assert lines[1] == "1,2.0,0.1,"
+        assert lines[2] == "2,1.0,0.5,9.0"
 
     def test_resume_does_not_duplicate_header(self, tmp_path):
         path = tmp_path / "results.csv"
