@@ -42,13 +42,6 @@ RUNS = [
         "data.val_rate=0.1", "data.global_batch=16", "train.epochs=10",
         "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
         f"train.workdir={OUT}/swin_moe"]),
-    ("resnet50_cls_hard", [
-        "tools/train.py", "model.name=resnet50",
-        "model.num_classes=100", "model.precision=f32",
-        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=2",
-        "optim.name=adamw", "optim.lr=0.001", "optim.warmup_steps=100",
-        f"train.workdir={OUT}/resnet50"]),
     ("yolox_tiny_det_hard", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
@@ -61,24 +54,29 @@ RUNS = [
         "data.max_gt=8", "data.mosaic=true",
         "data.random_perspective=true", "data.degrees=5",
         "train.steps=500", "train.lr=0.001"]),
-    ("fasterrcnn_r18_det_hard", [
-        "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
+    ("retinanet_r18_det_hard", [
+        "tools/train_detection.py", "model.name=retinanet_resnet18_fpn",
         "model.num_classes=10", "model.image_size=128",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=600", "train.lr=0.0005"]),
+        "data.max_gt=8", "train.steps=500", "train.lr=0.0005"]),
+    ("resnet18_cls_hard", [
+        "tools/train.py", "model.name=resnet18",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=32", "train.epochs=3",
+        "optim.name=adamw", "optim.lr=0.001", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/resnet18"]),
     ("hrnet_w18_seg_hard", [
         "tools/train_task.py", "--task", "segmentation",
         "model.name=hrnet_w18_seg", "model.num_classes=11",
         f"data.npz={DATA}/seg_hard/seg_hard.npz", "data.batch=8",
         "train.steps=500", "train.lr=0.001"]),
-    ("vit_s16_cls_hard_v2", [
-        "tools/train.py", "model.name=vit_small_patch16_224",
-        "model.num_classes=100", "model.precision=f32",
-        f"data.npz={DATA}/cls_hard/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=4",
-        "train.label_smoothing=0.1", "optim.name=adamw",
-        "optim.lr=0.002", "optim.weight_decay=0.05",
-        "optim.warmup_steps=300", f"train.workdir={OUT}/vit_s16"]),
+    # two-stage demo: ~30 s/step on this box, so a short loss-curve run
+    ("fasterrcnn_r18_short", [
+        "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "train.steps=80", "train.lr=0.0005"]),
 ]
 
 
