@@ -172,8 +172,9 @@ class TensorBoardWriter:
 class CsvLogger:
     """Append-per-step CSV metrics file, process-0 only — the yolov5
     pluggable-loggers csv path (utils/loggers/__init__.py:17-27,
-    results.csv). Columns are fixed on first write; later dicts may omit
-    keys (blank cell) but new keys are ignored with a warning."""
+    results.csv). Columns are set on first write; later dicts may omit
+    keys (blank cell), and new keys widen the header in place (the file
+    is rewritten with the wider header, old rows padded with blanks)."""
 
     def __init__(self, path: Optional[str]):
         self._path = path if (path and is_main_process()) else None

@@ -51,6 +51,11 @@ def _pipeline_schedule(
     then activations ppermute one stage forward."""
     s = mesh.shape[axis_name]
     m = x.shape[0]
+    if m % s != 0:
+        raise ValueError(
+            f"microbatches ({m}) must be divisible by pipeline stages "
+            f"({s}): the (M,...) input is sharded P({axis_name!r}) for "
+            "storage, so a non-multiple silently truncates outputs")
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
 

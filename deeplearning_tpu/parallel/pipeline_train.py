@@ -153,10 +153,11 @@ def make_pipeline_train_step(model, mesh: Mesh, tx,
 
     def eval_step(state, batch):
         logits = forward(state.params, batch["image"])
-        labels = batch["label"]
-        # count-style metrics: the Trainer divides by "count" at the end
-        return {"correct": (logits.argmax(-1) == labels).sum(),
-                "count": jnp.asarray(labels.shape[0], jnp.float32)}
+        # count-style metrics: the Trainer divides by "count" at the end,
+        # turning this into top-1 accuracy — named "top1" so the Trainer's
+        # default best_metric tracks pipeline runs too
+        from ..evaluation.metrics import topk_correct
+        return topk_correct(logits, batch["label"], ks=(1,))
 
     return (jax.jit(train_step, donate_argnums=(0,)), jax.jit(eval_step))
 

@@ -102,9 +102,15 @@ TRANSFORMER_TP_RULES: Rules = (
 FSDP_RULES: Rules = (
     # anchored to the transformer paths (blocks_*/attn/proj,
     # stage*_block*/attn/proj, */mlp/fc2) so 4-D conv kernels that happen
-    # to be NAMED proj (ViT patch_embed/proj and friends) stay on the
-    # output-dim rule instead of sharding a tiny spatial dim
+    # to be NAMED proj (ViT patch_embed/proj and friends) fall through to
+    # the conv rule below instead of input-dim sharding.
     (r"(attn/proj|mlp/fc2)/kernel$", P(FSDP_AXIS, None)),
+    # 4-D HWIO conv kernels: shard the OUTPUT-feature dim. Listed before
+    # the 2-D fallback because lookup skips any rule whose spec rank
+    # exceeds the leaf rank, so dense kernels fall through to the next
+    # rule while convs stop here (a bare P(None, fsdp) on a 4-D leaf
+    # would shard dim 1 — the tiny spatial kw dim).
+    (r"kernel$", P(None, None, None, FSDP_AXIS)),
     (r"kernel$", P(None, FSDP_AXIS)),
 )
 

@@ -123,9 +123,14 @@ class Trainer:
             if self.ckpt:
                 self.ckpt.wait_until_finished()
         self.callbacks.fire("after_train", self)
-        self.hub.summary({"best_" + self.best_metric: self.best_value,
-                          "epochs": self.epoch,
-                          **getattr(self, "_last_eval", {})})
+        # self.epochs, not self.epoch: the loop leaves self.epoch at the
+        # last INDEX (epochs-1), and summary only runs on normal exit
+        summary = {"epochs": self.epochs, **getattr(self, "_last_eval", {})}
+        # omit when the metric never updated (no eval loader): -inf would
+        # serialize as the non-standard JSON token -Infinity
+        if self.best_value != float("-inf"):
+            summary["best_" + self.best_metric] = self.best_value
+        self.hub.summary(summary)
         self.hub.close()
         return self.state
 

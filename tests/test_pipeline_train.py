@@ -106,7 +106,7 @@ class TestPipelineTraining:
         last = float(metrics["loss"])
         assert last < 0.5 * first, (first, last)
         counts = eval_step(state, batch)
-        acc = float(counts["correct"]) / float(counts["count"])
+        acc = float(counts["top1"]) / float(counts["count"])
         assert acc > 0.8
 
     def test_depth_not_divisible_raises(self):
