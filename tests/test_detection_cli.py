@@ -49,3 +49,19 @@ def test_exp_flag_drives_cli(capsys):
                "model.num_classes=3", "train.steps=2"])
     assert rc == 0
     assert "'AP'" in capsys.readouterr().out
+
+
+def test_no_aug_steps_closes_mosaic_and_adds_l1(capsys):
+    """train.no_aug_steps switches the last N steps to the aug-free
+    source and (YOLOX) enables the L1 loss — the step-based analog of the
+    reference's close-mosaic schedule (YOLOX/yolox/core/trainer.py:187-202
+    before_epoch: close_mosaic + use_l1)."""
+    from train_detection import main
+    rc = main(["model.name=yolox_nano", "model.image_size=64",
+               "data.batch=2", "data.n_train=4", "data.mosaic=true",
+               "data.random_perspective=true", "train.steps=4",
+               "train.no_aug_steps=2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "closing mosaic/perspective + adding L1 loss" in out
+    assert "'AP'" in out

@@ -54,6 +54,21 @@ RUNS = [
         "data.max_gt=8", "data.mosaic=true",
         "data.random_perspective=true", "data.degrees=5",
         "train.steps=500", "train.lr=0.001"]),
+    # round-5 matched-budget aug comparison (VERDICT r4 #2): plain vs
+    # mosaic+random_perspective with the close-mosaic schedule (last 20%
+    # of steps aug-free + YOLOX L1), both 2000 steps
+    ("yolox_tiny_det_hard_2k", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "train.steps=2000", "train.lr=0.001"]),
+    ("yolox_tiny_det_hard_mosaic_close", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "data.mosaic=true",
+        "data.random_perspective=true", "data.degrees=5",
+        "train.steps=2000", "train.no_aug_steps=400", "train.lr=0.001"]),
     ("retinanet_r18_det_hard", [
         "tools/train_detection.py", "model.name=retinanet_resnet18_fpn",
         "model.num_classes=10", "model.image_size=128",

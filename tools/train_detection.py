@@ -80,6 +80,12 @@ class DetTrainCfg:
     multiscale_min: float = 0.75      # bucket range as ratios of image_size
     multiscale_max: float = 1.25
     multiscale_every: int = 10        # steps between size changes
+    no_aug_steps: int = 0             # close mosaic/perspective for the
+                                      # LAST N steps and (YOLOX) add the
+                                      # L1 loss — the step-based analog of
+                                      # the reference's no_aug_epochs
+                                      # close-mosaic schedule
+                                      # (YOLOX/yolox/core/trainer.py:187-202)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,15 +159,15 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
         from deeplearning_tpu.models.detection.yolox import (
             yolox_grid, yolox_loss, yolox_postprocess)
 
-        def loss_fn(params, stats, batch, rng):
+        def loss_fn(params, stats, batch, rng, use_l1=False):
             hw = batch["image"].shape[1:3]
             centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
             out, new_stats = apply_train(params, stats, batch["image"])
             l = yolox_loss(out, centers, strides, batch["boxes"],
                            batch["labels"], batch["valid"],
-                           num_classes=num_classes)
-            return (l["iou_loss"] + l["obj_loss"] + l["cls_loss"],
-                    new_stats)
+                           num_classes=num_classes, use_l1=use_l1)
+            return (l["iou_loss"] + l["obj_loss"] + l["cls_loss"]
+                    + l["l1_loss"], new_stats)
 
         def predict_fn(params, stats, images):
             hw = images.shape[1:3]
@@ -373,6 +379,20 @@ def run(cfg) -> dict:
             max_boxes=cfg.data.max_gt, seed=cfg.train.seed,
             perspective=persp, fill=float(np.median(images[0])))
 
+    # close-mosaic (trainer.py:187-202 close_mosaic): a geometric-aug-free
+    # source for the final no_aug_steps. coco mode keeps the photometric
+    # augs and drops mosaic/perspective; array modes fall back to the raw
+    # arrays (built below, where the array batch fn lives).
+    plain_src = None
+    if cfg.train.no_aug_steps > 0 and cfg.data.coco and (
+            cfg.data.mosaic or cfg.data.random_perspective):
+        plain_aug, _ = coco_detection_source(
+            images_dir=images_dir, records=records,
+            class_names=class_names, image_size=size,
+            max_gt=cfg.data.max_gt, augment=True, seed=cfg.train.seed + 1)
+        plain_src = MapSource(len(tr_idx),
+                              lambda i: plain_aug[int(tr_idx[i])])
+
     model_classes = num_classes + (
         1 if cfg.model.name.startswith("fasterrcnn") else 0)  # +background
     model_kw = {}
@@ -404,9 +424,13 @@ def run(cfg) -> dict:
                                       change_every=cfg.train.multiscale_every,
                                       seed=cfg.train.seed)
 
-    @jax.jit
-    def step(params, opt_state, stats, batch, key):
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("use_l1",))
+    def step(params, opt_state, stats, batch, key, use_l1=False):
         def loss_fn(p):
+            if use_l1:
+                return loss_fn_task(p, stats, batch, key, use_l1=True)
             return loss_fn_task(p, stats, batch, key)
         (total, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -416,31 +440,56 @@ def run(cfg) -> dict:
 
     rng = np.random.default_rng(cfg.train.seed)
     key = jax.random.key(cfg.train.seed)
-    if train_src is not None:
+
+    def make_loader_fn(src, seed):
         from deeplearning_tpu.data.loader import DataLoader
-        loader = DataLoader(train_src, cfg.data.batch, shuffle=True,
-                            seed=cfg.train.seed, infinite=True,
+        loader = DataLoader(src, cfg.data.batch, shuffle=True, seed=seed,
+                            infinite=True,
                             num_workers=cfg.data.num_workers)
-        batch_iter = iter(loader)
-        next_batch = lambda: {k: jnp.asarray(v) for k, v in
-                              next(batch_iter).items()}
-    else:
+        it = iter(loader)
+        return lambda: {k: jnp.asarray(v) for k, v in next(it).items()}
+
+    def make_array_fn():
         n = len(images)
 
-        def next_batch():
+        def fn():
             idx = rng.choice(n, cfg.data.batch, replace=False)
             return {"image": jnp.asarray(images[idx]),
                     "boxes": jnp.asarray(boxes[idx]),
                     "labels": jnp.asarray(labels[idx]),
                     "valid": jnp.asarray(valid[idx])}
+        return fn
+
+    next_batch = (make_loader_fn(train_src, cfg.train.seed)
+                  if train_src is not None else make_array_fn())
+    if cfg.train.no_aug_steps >= max(cfg.train.steps, 1):
+        raise ValueError(
+            f"train.no_aug_steps={cfg.train.no_aug_steps} must be < "
+            f"train.steps={cfg.train.steps} (it is the length of the "
+            "FINAL aug-free phase)")
+    aug_close_at = (cfg.train.steps - cfg.train.no_aug_steps
+                    if cfg.train.no_aug_steps > 0 else None)
+    next_batch_plain = next_batch
+    if aug_close_at is not None:
+        if plain_src is not None:
+            next_batch_plain = make_loader_fn(plain_src,
+                                              cfg.train.seed + 1)
+        elif train_src is not None and not cfg.data.coco:
+            next_batch_plain = make_array_fn()   # raw npz/synthetic arrays
+    is_yolox = cfg.model.name.startswith("yolox")
 
     for it in range(cfg.train.steps):
-        batch = next_batch()
+        closing = aug_close_at is not None and it >= aug_close_at
+        if closing and it == aug_close_at:
+            print(f"step {it}: closing mosaic/perspective"
+                  + (" + adding L1 loss" if is_yolox else ""))
+        batch = (next_batch_plain if closing else next_batch)()
         if schedule is not None:
             batch = resize_detection_batch(batch,
                                            schedule.size_for_step(it))
         params, opt_state, stats, total = step(
-            params, opt_state, stats, batch, jax.random.fold_in(key, it))
+            params, opt_state, stats, batch, jax.random.fold_in(key, it),
+            use_l1=bool(closing and is_yolox))
         if it % max(cfg.train.steps // 5, 1) == 0:
             print(f"step {it}: loss={float(total):.4f}")
 
