@@ -114,8 +114,11 @@ def retinanet_anchors(image_hw: Tuple[int, int]) -> np.ndarray:
 def retinanet_loss(outputs: Dict, anchors: jax.Array, gt_boxes: jax.Array,
                    gt_labels: jax.Array, gt_valid: jax.Array
                    ) -> Dict[str, jax.Array]:
-    """Focal cls loss over all non-ignored anchors + smooth-L1 on positives
-    (RetinaNet compute_loss surface; matcher 0.5/0.4 w/ low-quality).
+    """Focal cls loss over all non-ignored anchors + plain L1 on positives
+    (RetinaNet compute_loss surface; matcher 0.5/0.4 w/ low-quality;
+    the reference regression loss is F.l1_loss, retinanet.py:188-193,
+    NOT smooth-L1 — both normalized per image by num_foreground then
+    averaged over the batch).
 
     gt_boxes (B, G, 4); gt_labels (B, G) int; gt_valid (B, G) bool.
     """
@@ -134,9 +137,7 @@ def retinanet_loss(outputs: Dict, anchors: jax.Array, gt_boxes: jax.Array,
             cls_logits, target_cls, reduction="none")
         cls_loss = jnp.sum(cls_loss * (~ignore)[:, None])
         reg_targets = box_ops.encode_boxes(boxes[safe], anchors)
-        reg_loss = L.smooth_l1(deltas, reg_targets, beta=1.0 / 9,
-                               reduction="none")
-        reg_loss = jnp.sum(reg_loss * pos[:, None])
+        reg_loss = jnp.sum(jnp.abs(deltas - reg_targets) * pos[:, None])
         num_pos = jnp.maximum(jnp.sum(pos), 1)
         return cls_loss / num_pos, reg_loss / num_pos
 

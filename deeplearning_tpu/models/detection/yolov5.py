@@ -169,30 +169,52 @@ def yolov5_loss(raw: jax.Array, grid: Dict[str, jax.Array],
                 gt_boxes: jax.Array, gt_labels: jax.Array,
                 gt_valid: jax.Array, num_classes: int,
                 box_gain: float = 0.05, obj_gain: float = 1.0,
-                cls_gain: float = 0.5) -> Dict[str, jax.Array]:
+                cls_gain: float = 0.5,
+                balance: Sequence[float] = (4.0, 1.0, 0.4)
+                ) -> Dict[str, jax.Array]:
+    """ComputeLoss surface (yolov5/utils/loss.py:128-180), dense masked
+    form with the reference's exact normalization: per-LEVEL means
+    accumulated batch-globally (CIoU box loss and BCE cls loss averaged
+    over that level's positives across the whole batch; obj BCE averaged
+    over every slot of the level and weighted by ``balance``). CIoU is
+    scale-invariant, so computing it on fully decoded pixel boxes equals
+    the reference's grid-unit computation. The reference's final ``* bs``
+    factor (loss.py:189) is NOT applied — it is a constant absorbed into
+    the LR here. Slots claimed by several gt (rare) take the min-wh-ratio
+    one, where the reference duplicates rows."""
     decoded = decode_yolov5(raw, grid)
     targets = build_targets(grid, gt_boxes, gt_labels, gt_valid)
-
-    def per_image(raw_i, dec_i, boxes, labels, tgt):
-        pos = tgt["pos"]
-        mg = tgt["matched_gt"]
-        tgt_boxes = boxes[mg]
-        ciou = box_ops.elementwise_box_iou(dec_i[:, :4], tgt_boxes, "ciou")
-        n_pos = jnp.maximum(jnp.sum(pos), 1)
-        box_loss = jnp.sum((1.0 - ciou) * pos) / n_pos
-        obj_t = jnp.where(pos, jax.lax.stop_gradient(
-            jnp.clip(ciou, 0, 1)), 0.0)
-        obj_loss = L.binary_cross_entropy(raw_i[:, 4], obj_t)
-        cls_t = jax.nn.one_hot(labels[mg], num_classes)
-        cls_loss = L.binary_cross_entropy(raw_i[:, 5:], cls_t,
-                                          weights=pos[:, None])
-        return box_loss, obj_loss, cls_loss
-
-    box_l, obj_l, cls_l = jax.vmap(per_image)(
-        raw, decoded, gt_boxes, gt_labels, targets)
-    return {"box_loss": box_gain * jnp.mean(box_l),
-            "obj_loss": obj_gain * jnp.mean(obj_l),
-            "cls_loss": cls_gain * jnp.mean(cls_l)}
+    pos = targets["pos"].astype(jnp.float32)              # (B, A)
+    mg = targets["matched_gt"]                            # (B, A)
+    tgt_boxes = jnp.take_along_axis(
+        gt_boxes, mg[..., None], axis=1)                  # (B, A, 4)
+    ciou = jax.vmap(lambda d, t: box_ops.elementwise_box_iou(
+        d[:, :4], t, "ciou"))(decoded, tgt_boxes)
+    obj_t = jnp.where(pos > 0, jax.lax.stop_gradient(
+        jnp.clip(ciou, 0.0, 1.0)), 0.0)
+    obj_bce = L.binary_cross_entropy(raw[..., 4], obj_t,
+                                     reduction="none")    # (B, A)
+    cls_t = jax.nn.one_hot(jnp.take_along_axis(gt_labels, mg, axis=1),
+                           num_classes)
+    cls_bce = L.binary_cross_entropy(raw[..., 5:], cls_t,
+                                     reduction="none")    # (B, A, K)
+    # per-level masks from the STATIC stride ladder (grid["stride"] may be
+    # a tracer under jit; yolov5_grid always lays levels out over STRIDES)
+    box_loss = obj_loss = cls_loss = jnp.zeros(())
+    for li, s in enumerate(STRIDES):
+        m = (grid["stride"] == s).astype(jnp.float32)     # (A,)
+        n_slots = jnp.maximum(jnp.sum(m), 1.0)
+        n_pos = jnp.sum(pos * m)
+        denom = jnp.maximum(n_pos, 1.0)
+        box_loss += jnp.sum((1.0 - ciou) * pos * m) / denom
+        obj_loss += (jnp.sum(obj_bce * m) / (raw.shape[0] * n_slots)) \
+            * balance[min(li, len(balance) - 1)]
+        if num_classes > 1:                  # loss.py:157 `if self.nc > 1`
+            cls_loss += jnp.sum(cls_bce * (pos * m)[..., None]) \
+                / (denom * num_classes)
+    return {"box_loss": box_gain * box_loss,
+            "obj_loss": obj_gain * obj_loss,
+            "cls_loss": cls_gain * cls_loss}
 
 
 def yolov5_postprocess(raw: jax.Array, grid: Dict[str, jax.Array],

@@ -24,18 +24,20 @@ from ...core.registry import MODELS
 from ..classification.vit import Block
 
 
-def random_masking(x: jax.Array, mask_ratio: float, rng: jax.Array
+def random_masking(x: jax.Array, mask_ratio: float, rng: jax.Array,
+                   noise: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-image token shuffle-mask. x (B, N, C) → (kept (B, K, C),
-    mask (B, N) 1=masked, restore_idx (B, N))."""
+    mask (B, N) 1=masked, restore_idx (B, N)). ``noise`` overrides the
+    uniform draw (reproducible masking for tests/visualisation)."""
     b, n, c = x.shape
     keep = int(n * (1 - mask_ratio))
-    noise = jax.random.uniform(rng, (b, n))
+    if noise is None:
+        noise = jax.random.uniform(rng, (b, n))
     shuffle = jnp.argsort(noise, axis=1)          # random perm per image
     restore = jnp.argsort(shuffle, axis=1)
     kept_idx = shuffle[:, :keep]
     kept = jnp.take_along_axis(x, kept_idx[:, :, None], axis=1)
-    mask = jnp.ones((b, n), x.dtype)
     mask = jnp.take_along_axis(
         jnp.concatenate([jnp.zeros((b, keep), x.dtype),
                          jnp.ones((b, n - keep), x.dtype)], axis=1),
@@ -74,10 +76,12 @@ class MAE(nn.Module):
 
     @nn.compact
     def __call__(self, imgs: jax.Array, train: bool = False,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 mask_noise: Optional[jax.Array] = None):
         """Returns (loss, pred_patches, mask). ``rng`` drives masking; in
-        eval a fixed fold of the dropout rng is used."""
-        if rng is None:
+        eval a fixed fold of the dropout rng is used. ``mask_noise``
+        (B, N) overrides the random mask draw (tests/visualisation)."""
+        if rng is None and mask_noise is None:
             rng = self.make_rng("masking")
         b, h, w, c = imgs.shape
         p = self.patch_size
@@ -92,7 +96,8 @@ class MAE(nn.Module):
                              nn.initializers.truncated_normal(0.02),
                              (1, n, self.embed_dim), jnp.float32)
         x = x + enc_pos.astype(x.dtype)
-        kept, mask, restore = random_masking(x, self.mask_ratio, rng)
+        kept, mask, restore = random_masking(x, self.mask_ratio, rng,
+                                             noise=mask_noise)
         for i in range(self.depth):
             kept = Block(self.num_heads, dtype=self.dtype,
                          attn_fn=self.attn_fn,

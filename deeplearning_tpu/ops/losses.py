@@ -31,6 +31,17 @@ def _weighted_mean(x: jax.Array, weights: Optional[jax.Array]) -> jax.Array:
     return jnp.sum(x * weights) / jnp.maximum(jnp.sum(weights), 1.0)
 
 
+def _reduce(losses, weights, reduction):
+    """Shared none/sum/weighted-mean reduction used by the loss family."""
+    if weights is not None and reduction in ("none", "sum"):
+        losses = losses * weights
+    if reduction == "none":
+        return losses
+    if reduction == "sum":
+        return jnp.sum(losses)
+    return _weighted_mean(losses, weights)
+
+
 def cross_entropy(logits: jax.Array, labels: jax.Array,
                   label_smoothing: float = 0.0,
                   weights: Optional[jax.Array] = None) -> jax.Array:
@@ -58,11 +69,12 @@ def soft_target_cross_entropy(logits: jax.Array, targets: jax.Array,
 
 def binary_cross_entropy(logits: jax.Array, targets: jax.Array,
                          weights: Optional[jax.Array] = None,
-                         pos_weight: float = 1.0) -> jax.Array:
+                         pos_weight: float = 1.0,
+                         reduction: str = "mean") -> jax.Array:
     log_p = jax.nn.log_sigmoid(logits)
     log_not_p = jax.nn.log_sigmoid(-logits)
     losses = -(pos_weight * targets * log_p + (1.0 - targets) * log_not_p)
-    return _weighted_mean(losses, weights)
+    return _reduce(losses, weights, reduction)
 
 
 def sigmoid_focal_loss(logits: jax.Array, targets: jax.Array,
@@ -78,11 +90,7 @@ def sigmoid_focal_loss(logits: jax.Array, targets: jax.Array,
     if alpha >= 0:
         alpha_t = alpha * targets + (1 - alpha) * (1 - targets)
         loss = alpha_t * loss
-    if reduction == "none":
-        return loss if weights is None else loss * weights
-    if reduction == "sum":
-        return jnp.sum(loss if weights is None else loss * weights)
-    return _weighted_mean(loss, weights)
+    return _reduce(loss, weights, reduction)
 
 
 def dice_coefficient(probs: jax.Array, targets: jax.Array,
@@ -136,11 +144,7 @@ def smooth_l1(pred: jax.Array, target: jax.Array, beta: float = 1.0 / 9,
     """Huber / smooth-L1 (fasterRcnn utils/det_utils.py:386)."""
     diff = jnp.abs(pred - target)
     loss = jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
-    if reduction == "none":
-        return loss if weights is None else loss * weights
-    if reduction == "sum":
-        return jnp.sum(loss if weights is None else loss * weights)
-    return _weighted_mean(loss, weights)
+    return _reduce(loss, weights, reduction)
 
 
 def supcon_loss(features: jax.Array, labels: jax.Array,

@@ -16,6 +16,14 @@ Covered reference surfaces:
 - detection/RetinaNet/network_files/losses.py         sigmoid_focal_loss
 - detection/yolov5/utils/metrics.py                   bbox_iou (G/D/CIoU)
 - classification/RepVGG/models/repvgg.py              RepVGG train form
+- classification/swin_transformer/.../swin_transformer_v2.py  SwinV2
+  (cosine attention, log-CPB, res-post-norm)
+- detection/RetinaNet/network_files/retinanet.py:23,120,59,153  heads
+  forward + compute_loss (Matcher/BoxCoder/num_foreground norm)
+- detection/yolov5/models/yolo.py:65      Detect inference decode
+- detection/yolov5/utils/loss.py:91-300   ComputeLoss (per-level means,
+  obj balance, CIoU box loss, IoU-weighted obj targets)
+- self-supervised/MAE/models/MAE.py:72-141  shuffle/mask/unshuffle
 """
 
 import contextlib
@@ -435,3 +443,431 @@ def test_repvgg_forward_parity():
                    dtype=jnp.float32)
     got = model.apply(variables, jnp.asarray(x), train=False)
     _assert_close(got, want)
+
+
+# -------------------------------------------------------------- Swin v2
+
+def test_swinv2_forward_parity():
+    """Cosine attention + log-CPB + res-post-norm v2 path vs the
+    reference's own SwinTransformerV2
+    (classification/swin_transformer/models/swin_transformer_v2.py)."""
+    swin_dir = REF / "classification/swin_transformer/models"
+    with _isolated_imports(stubs=_timm_stub()):
+        ref = _load_by_path("ref_swinv2", swin_dir / "swin_transformer_v2.py")
+        torch.manual_seed(0)
+        net = ref.SwinTransformerV2(
+            img_size=32, patch_size=2, num_classes=10, embed_dim=16,
+            depths=[2, 2], num_heads=[2, 4], window_size=4,
+            drop_path_rate=0.0, ape=False, patch_norm=True)
+        _randomize_torch(net)
+        with torch.no_grad():
+            for k, v in net.state_dict().items():
+                if k.endswith(("logit_scale",)):
+                    v.uniform_(0.5, 2.0)
+        x = np.random.default_rng(6).normal(
+            size=(2, 32, 32, 3)).astype("f4")
+        with torch.no_grad():
+            want = net(_nchw(x)).numpy()
+
+    def rename(stem):
+        stem = stem.replace("patch_embed.proj", "patch_embed")
+        stem = stem.replace("patch_embed.norm", "patch_norm")
+        stem = re.sub(r"layers\.(\d+)\.blocks\.(\d+)",
+                      r"stage\1_block\2", stem)
+        stem = re.sub(r"layers\.(\d+)\.downsample", r"stage\1_merge", stem)
+        stem = stem.replace("cpb_mlp.0", "cpb_fc1")
+        stem = stem.replace("cpb_mlp.2", "cpb_fc2")
+        return stem
+
+    variables = _port(net, rename,
+                      drop_suffixes=("relative_position_index",
+                                     "attn_mask", "relative_coords_table"))
+    from deeplearning_tpu.models.classification.swin import SwinTransformer
+    model = SwinTransformer(
+        patch_size=2, num_classes=10, embed_dim=16, depths=(2, 2),
+        num_heads=(2, 4), window=4, drop_path_rate=0.0, v2=True,
+        dtype=jnp.float32)
+    got = model.apply(variables, jnp.asarray(x), train=False)
+    _assert_close(got, want)
+
+
+# --------------------------------------------------------- RetinaNet head
+
+def _load_retinanet_modules():
+    """Import the self-contained network_files package with a torchvision
+    stub (only _is_tracing is touched outside the nms op)."""
+    tv = types.ModuleType("torchvision")
+    tv._is_tracing = lambda: False
+    return (REF / "detection/RetinaNet"), {"torchvision": tv}
+
+
+def test_retinanet_head_forward_parity():
+    """Classification/regression conv towers + (H,W,A,K) flatten order vs
+    RetinaNetClassificationHead/RegressionHead
+    (detection/RetinaNet/network_files/retinanet.py:23,120)."""
+    ret_dir, stubs = _load_retinanet_modules()
+    with _isolated_imports(extra_sys_path=[ret_dir], stubs=stubs):
+        rn = importlib.import_module("network_files.retinanet")
+        torch.manual_seed(0)
+        cls_net = rn.RetinaNetClassificationHead(32, num_anchors=9,
+                                                 num_classes=5)
+        reg_net = rn.RetinaNetRegressionHead(32, num_anchors=9)
+        _randomize_torch(cls_net, seed=1)
+        _randomize_torch(reg_net, seed=2)
+        rng = np.random.default_rng(7)
+        f1 = rng.normal(size=(2, 8, 8, 32)).astype("f4")
+        f2 = rng.normal(size=(2, 4, 4, 32)).astype("f4")
+        with torch.no_grad():
+            want_cls = cls_net([_nchw(f1), _nchw(f2)]).numpy()
+            want_reg = reg_net([_nchw(f1), _nchw(f2)]).numpy()
+
+    def rename(stem):
+        stem = re.sub(r"conv\.(\d+)",
+                      lambda m: f"conv{int(m.group(1)) // 2}", stem)
+        stem = stem.replace("cls_logits", "pred")
+        stem = stem.replace("bbox_reg", "pred")
+        return stem
+
+    from deeplearning_tpu.models.detection.retinanet import RetinaHead
+    cls_vars = _port(cls_net, rename)
+    reg_vars = _port(reg_net, rename)
+    cls_head = RetinaHead(5 * 9, channels=32, dtype=jnp.float32)
+    reg_head = RetinaHead(4 * 9, channels=32, dtype=jnp.float32)
+    got_cls = jnp.concatenate(
+        [cls_head.apply(cls_vars, jnp.asarray(f)).reshape(2, -1, 5)
+         for f in (f1, f2)], axis=1)
+    got_reg = jnp.concatenate(
+        [reg_head.apply(reg_vars, jnp.asarray(f)).reshape(2, -1, 4)
+         for f in (f1, f2)], axis=1)
+    _assert_close(got_cls, want_cls, tol=2e-4)
+    _assert_close(got_reg, want_reg, tol=2e-4)
+
+
+def test_retinanet_loss_parity():
+    """Matcher(0.5/0.4 low-quality) + BoxCoder encode + the exact
+    per-image num_foreground normalization vs the reference heads'
+    compute_loss (retinanet.py:59-101,153-196)."""
+    ret_dir, stubs = _load_retinanet_modules()
+    rng = np.random.default_rng(8)
+    # plausible anchors + gt on a 64x64 image
+    cxy = rng.uniform(8, 56, (40, 2))
+    wh = rng.uniform(6, 30, (40, 2))
+    anchors_np = np.concatenate([cxy - wh / 2, cxy + wh / 2],
+                                1).astype("f4")
+    B, G, K = 2, 3, 5
+    gxy = rng.uniform(10, 50, (B, G, 2))
+    gwh = rng.uniform(8, 28, (B, G, 2))
+    gt_boxes = np.concatenate([gxy - gwh / 2, gxy + gwh / 2],
+                              -1).astype("f4")
+    gt_labels = rng.integers(0, K, (B, G))
+    cls_logits = rng.normal(0, 1, (B, 40, K)).astype("f4")
+    deltas = rng.normal(0, 0.3, (B, 40, 4)).astype("f4")
+
+    with _isolated_imports(extra_sys_path=[ret_dir], stubs=stubs):
+        rn = importlib.import_module("network_files.retinanet")
+        det_utils = importlib.import_module("network_files.det_utils")
+        box_mod = importlib.import_module("network_files.boxes")
+        matcher = det_utils.Matcher(0.5, 0.4, allow_low_quality_matches=True)
+        matched = [matcher(box_mod.box_iou(
+            torch.from_numpy(gt_boxes[i]), torch.from_numpy(anchors_np)))
+            for i in range(B)]
+        targets = [{"boxes": torch.from_numpy(gt_boxes[i]),
+                    "labels": torch.from_numpy(gt_labels[i])}
+                   for i in range(B)]
+        torch.manual_seed(0)
+        cls_net = rn.RetinaNetClassificationHead(32, 9, K)
+        reg_net = rn.RetinaNetRegressionHead(32, 9)
+        head_out = {"cls_logits": torch.from_numpy(cls_logits),
+                    "bbox_regression": torch.from_numpy(deltas)}
+        with torch.no_grad():
+            want_cls = float(cls_net.compute_loss(
+                targets, head_out, matched))
+            want_reg = float(reg_net.compute_loss(
+                targets, head_out, [torch.from_numpy(anchors_np)] * B,
+                matched))
+
+    from deeplearning_tpu.models.detection.retinanet import retinanet_loss
+    got = retinanet_loss(
+        {"cls_logits": jnp.asarray(cls_logits),
+         "bbox_deltas": jnp.asarray(deltas)},
+        jnp.asarray(anchors_np), jnp.asarray(gt_boxes),
+        jnp.asarray(gt_labels), jnp.ones((B, G), bool))
+    _assert_close(got["cls_loss"], want_cls, tol=1e-4)
+    _assert_close(got["reg_loss"], want_reg, tol=1e-4)
+
+
+# ------------------------------------------------- yolov5 Detect decode
+
+def _y5_stubs():
+    stubs = {
+        "utils": types.ModuleType("utils"),
+        "utils.datasets": _dummy_module(
+            "utils.datasets", ["exif_transpose", "letterbox"]),
+        "utils.general": _dummy_module(
+            "utils.general",
+            ["non_max_suppression", "make_divisible", "scale_coords",
+             "increment_path", "xyxy2xywh", "save_one_box", "check_file",
+             "set_logging"]),
+        "utils.plots": _dummy_module(
+            "utils.plots", ["colors", "plot_one_box",
+                            "feature_visualization"]),
+        "utils.torch_utils": _dummy_module(
+            "utils.torch_utils",
+            ["time_sync", "fuse_conv_and_bn", "model_info", "scale_img",
+             "initialize_weights", "select_device", "copy_attr"]),
+        "utils.autoanchor": _dummy_module(
+            "utils.autoanchor", ["check_anchor_order"]),
+        "models": types.ModuleType("models"),
+        "models.experimental": types.ModuleType("models.experimental"),
+    }
+    return stubs
+
+
+def test_yolov5_detect_decode_parity():
+    """Inference-time box decode xy=(2s-0.5+grid)*stride,
+    wh=(2s)^2*anchor vs the reference Detect module's own forward
+    (detection/yolov5/models/yolo.py:65-120)."""
+    y5 = REF / "detection/yolov5"
+    anchors_px = [[10, 13, 16, 30, 33, 23],
+                  [30, 61, 62, 45, 59, 119],
+                  [116, 90, 156, 198, 373, 326]]
+    with _isolated_imports(stubs=_y5_stubs()):
+        _load_by_path("models.common", y5 / "models/common.py")
+        yolo = _load_by_path("ref_y5_yolo", y5 / "models/yolo.py")
+        torch.manual_seed(0)
+        det = yolo.Detect(nc=5, anchors=anchors_px, ch=(16, 16, 16))
+        det.stride = torch.tensor([8.0, 16.0, 32.0])
+        det = det.float().eval()
+        with torch.no_grad():
+            for conv in det.m:
+                conv.weight.normal_(0, 0.05)
+                conv.bias.normal_(0, 0.5)
+        rng = np.random.default_rng(9)
+        feats = [rng.normal(size=(2, 16, 64 // s, 64 // s)).astype("f4")
+                 for s in (8, 16, 32)]
+        with torch.no_grad():
+            z, raw_levels = det([torch.from_numpy(f) for f in feats])
+        # reference layout per level: (bs, na, ny, nx, no); flatten order
+        # of z is (na, ny, nx)
+        want = z.numpy()                      # (bs, sum(na*ny*nx), no)
+
+    # my layout is (ny, nx, na): rebuild my raw array from the reference's
+    # raw head outputs so ONLY the decode math is under test
+    my_raw = []
+    for lvl in raw_levels:
+        a = lvl.numpy()                        # (bs, na, ny, nx, no)
+        my_raw.append(a.transpose(0, 2, 3, 1, 4).reshape(
+            a.shape[0], -1, a.shape[-1]))
+    my_raw = np.concatenate(my_raw, axis=1)
+    want_mine_order = []
+    for lvl in np.split(want, np.cumsum(
+            [3 * (64 // s) ** 2 for s in (8, 16, 32)])[:-1], axis=1):
+        n = int(round((lvl.shape[1] // 3) ** 0.5))
+        b = lvl.reshape(lvl.shape[0], 3, n, n, -1)
+        want_mine_order.append(b.transpose(0, 2, 3, 1, 4).reshape(
+            lvl.shape[0], -1, b.shape[-1]))
+    want_mine_order = np.concatenate(want_mine_order, axis=1)
+
+    from deeplearning_tpu.models.detection.yolov5 import (decode_yolov5,
+                                                          yolov5_grid)
+    anchors = tuple(tuple((lvl[i], lvl[i + 1])
+                          for i in range(0, 6, 2)) for lvl in anchors_px)
+    grid = {k: jnp.asarray(v)
+            for k, v in yolov5_grid((64, 64), anchors).items()}
+    got = decode_yolov5(jnp.asarray(my_raw), grid)
+    # reference z: xywh in pixels + SIGMOIDED obj/cls; mine: xyxy + raw
+    got_xy = (got[..., :2] + got[..., 2:4]) / 2
+    got_wh = got[..., 2:4] - got[..., :2]
+    _assert_close(got_xy, want_mine_order[..., :2], tol=2e-4)
+    _assert_close(got_wh, want_mine_order[..., 2:4], tol=2e-4)
+    _assert_close(np.asarray(jax.nn.sigmoid(got[..., 4:])),
+                  want_mine_order[..., 4:], tol=1e-5)
+
+
+# ------------------------------------------------- yolov5 ComputeLoss
+
+def test_yolov5_compute_loss_parity():
+    """Dense masked yolov5_loss vs the reference ComputeLoss on a fixed
+    toy batch with unique slot assignments
+    (detection/yolov5/utils/loss.py:91-300): per-level means, obj
+    balance [4.0,1.0,0.4], CIoU box loss, IoU-weighted obj targets."""
+    y5 = REF / "detection/yolov5"
+    mpl = types.ModuleType("matplotlib")
+    mpl.pyplot = types.ModuleType("matplotlib.pyplot")
+    stubs = {**_y5_stubs(), "matplotlib": mpl,
+             "matplotlib.pyplot": mpl.pyplot}
+    anchors_px = np.array([[[10, 13], [16, 30], [33, 23]],
+                           [[30, 61], [62, 45], [59, 119]],
+                           [[116, 90], [156, 198], [373, 326]]], "f4")
+    strides = np.array([8.0, 16.0, 32.0], "f4")
+    size = 64
+    B, G, K = 2, 2, 5
+    rng = np.random.default_rng(10)
+    # gt away from borders and each other: unique slot assignments
+    gxy = np.array([[[20.0, 20.0], [44.0, 44.0]],
+                    [[28.0, 12.0], [12.0, 44.0]]], "f4")
+    gxy += rng.uniform(-1.5, 1.5, gxy.shape).astype("f4")
+    gwh = rng.uniform(10, 40, (B, G, 2)).astype("f4")
+    gt_boxes = np.concatenate([gxy - gwh / 2, gxy + gwh / 2], -1)
+    gt_labels = rng.integers(0, K, (B, G))
+    raw_levels = [rng.normal(0, 1, (B, 3, size // int(s), size // int(s),
+                                    5 + K)).astype("f4")
+                  for s in strides]
+
+    hyp = {"box": 0.05, "obj": 1.0, "cls": 0.5, "cls_pw": 1.0,
+           "obj_pw": 1.0, "fl_gamma": 0.0, "anchor_t": 4.0,
+           "label_smoothing": 0.0}
+    with _isolated_imports(stubs=stubs):
+        # loss.py needs the REAL bbox_iou (CIoU) and an is_parallel that
+        # says no; wire both into the utils stub package
+        metrics_mod = _load_by_path("utils.metrics",
+                                    y5 / "utils/metrics.py")
+        sys.modules["utils"].metrics = metrics_mod
+        sys.modules["utils.torch_utils"].is_parallel = lambda m: False
+        loss_mod = _load_by_path("ref_y5_loss", y5 / "utils/loss.py")
+
+        class FakeDetect(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.na, self.nc, self.nl = 3, K, 3
+                self.anchors = torch.from_numpy(
+                    anchors_px / strides[:, None, None])
+                self.stride = torch.from_numpy(strides)
+
+        class FakeModel(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.hyp = hyp
+                self.det = FakeDetect()
+                self.model = [self.det]
+                self._p = torch.nn.Parameter(torch.zeros(1))
+
+        compute = loss_mod.ComputeLoss(FakeModel())
+        # normalized (img, cls, x, y, w, h) target rows
+        rows = []
+        for b in range(B):
+            for g in range(G):
+                rows.append([b, gt_labels[b, g], gxy[b, g, 0] / size,
+                             gxy[b, g, 1] / size, gwh[b, g, 0] / size,
+                             gwh[b, g, 1] / size])
+        targets = torch.tensor(rows, dtype=torch.float32)
+        # newer torch forbids long.clamp_(float-tensor) — the reference
+        # ran on older torch; shim the bounds to scalars (same values)
+        orig_clamp = torch.Tensor.clamp_
+
+        def clamp_shim(self, mn=None, mx=None):
+            mn = float(mn) if isinstance(mn, torch.Tensor) else mn
+            mx = float(mx) if isinstance(mx, torch.Tensor) else mx
+            if self.dtype == torch.long:
+                mn = None if mn is None else int(mn)
+                mx = None if mx is None else int(mx)
+            return orig_clamp(self, mn, mx)
+
+        torch.Tensor.clamp_ = clamp_shim
+        try:
+            with torch.no_grad():
+                _, parts = compute(
+                    [torch.from_numpy(lv) for lv in raw_levels], targets)
+        finally:
+            torch.Tensor.clamp_ = orig_clamp
+        want_box, want_obj, want_cls = [float(v) for v in parts]
+
+    from deeplearning_tpu.models.detection.yolov5 import (yolov5_grid,
+                                                          yolov5_loss)
+    anchors = tuple(tuple(map(tuple, lvl)) for lvl in anchors_px)
+    grid = {k: jnp.asarray(v)
+            for k, v in yolov5_grid((size, size), anchors).items()}
+    my_raw = np.concatenate(
+        [lv.transpose(0, 2, 3, 1, 4).reshape(B, -1, 5 + K)
+         for lv in raw_levels], axis=1)
+    got = yolov5_loss(jnp.asarray(my_raw), grid, jnp.asarray(gt_boxes),
+                      jnp.asarray(gt_labels), jnp.ones((B, G), bool),
+                      num_classes=K)
+    _assert_close(got["box_loss"], want_box, tol=2e-4)
+    _assert_close(got["obj_loss"], want_obj, tol=2e-4)
+    _assert_close(got["cls_loss"], want_cls, tol=2e-4)
+
+
+# ---------------------------------------------------- MAE shuffle/mask
+
+def test_mae_mask_shuffle_parity():
+    """Shuffle/mask/unshuffle index bookkeeping vs the reference MAE's
+    own forward (self-supervised/MAE/models/MAE.py:72-141): with the
+    decoder and head replaced by Identity, the reference's masked-token
+    predictions are exactly mask_embed + decoder_pos_embed(idx) routed
+    through its scatter/gather chain, and mask_patches is its patchify
+    gather — both must match our random_masking/patchify/restore path
+    (kept-first argsort layout vs the reference's masked-first layout:
+    same sets under noise negation)."""
+    mae_dir = REF / "self-supervised/MAE"
+    p, D, B = 4, 16, 2
+    h = w = 16
+    n = (h // p) * (w // p)                   # 16 patches
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(B, h, w, 3)).astype("f4")
+    noise = rng.uniform(size=(B, n)).astype("f4")
+
+    with _isolated_imports(extra_sys_path=[mae_dir]):
+        mae_mod = importlib.import_module("models.MAE")
+
+        class StubEncoder(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.patch_h = self.patch_w = p
+                self.patch_embed = torch.nn.Linear(p * p * 3, D)
+                self.pos_embed = torch.nn.Parameter(
+                    torch.randn(1, n + 1, D))
+                self.transformer = torch.nn.Identity()
+
+        torch.manual_seed(0)
+        ref = mae_mod.MAE(StubEncoder(), decoder_dim=D, mask_ratio=0.75,
+                          decoder_depth=1)
+        ref.decoder = torch.nn.Identity()
+        ref.head = torch.nn.Identity()
+        ref.eval()
+        orig_rand = torch.rand
+        torch.rand = lambda *a, **kw: torch.from_numpy(noise)
+        try:
+            with torch.no_grad():
+                want_pred, want_mask_patches = ref(_nchw(x))
+        finally:
+            torch.rand = orig_rand
+        # recover the reference's mask ordering to sort by patch index
+        shuffle_ref = np.argsort(noise, axis=1)
+        num_masked = int(0.75 * n)
+        mask_idx_ref = shuffle_ref[:, :num_masked]
+        order = np.argsort(mask_idx_ref, axis=1)
+        want_pred = np.take_along_axis(
+            want_pred.numpy(), order[:, :, None], axis=1)
+        want_mask_patches = np.take_along_axis(
+            want_mask_patches.numpy(), order[:, :, None], axis=1)
+        mask_embed = ref.mask_embed.detach().numpy()
+        dec_pos = ref.decoder_pos_embed.weight.detach().numpy()
+
+    from deeplearning_tpu.models.ssl.mae import patchify, random_masking
+    patches = patchify(jnp.asarray(x), p)                  # (B, n, p²·3)
+    # negated noise: our kept-first prefix = the reference's kept suffix
+    kept, mask, restore = random_masking(
+        patches, 0.75, jax.random.key(0), noise=jnp.asarray(-noise))
+    mask = np.asarray(mask)
+    assert mask.sum() == B * num_masked
+    # same masked SETS as the reference
+    for b in range(B):
+        assert set(np.where(mask[b] > 0)[0]) == set(mask_idx_ref[b])
+    # mask_patches: the reference's gather == our patchify at mask slots
+    got_mask_patches = np.stack(
+        [np.asarray(patches)[b][mask[b] > 0] for b in range(B)])
+    _assert_close(got_mask_patches, want_mask_patches, tol=1e-5)
+    # the decoder fill/restore path (MAE.__call__ lines: concat kept with
+    # mask tokens, unshuffle via restore): with identity decoder the
+    # reference's pred at patch i is mask_embed + dec_pos[i]; ours after
+    # the SAME routing must agree elementwise
+    keep = n - num_masked
+    fill = np.broadcast_to(mask_embed, (B, n - keep, D))
+    marker = np.concatenate(
+        [np.zeros((B, keep, D), "f4"), fill.astype("f4")], axis=1)
+    full = np.take_along_axis(marker, np.asarray(restore)[:, :, None],
+                              axis=1)
+    got_pred = np.stack(
+        [(full[b] + dec_pos)[mask[b] > 0] for b in range(B)])
+    _assert_close(got_pred, want_pred, tol=1e-5)
