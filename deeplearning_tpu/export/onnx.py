@@ -437,6 +437,120 @@ def _reduce_window_max(g, eqn, ins, outs):
     g.node("Transpose", [y], outs, perm=[0, 2, 3, 1])
 
 
+@register_onnx_lowering("iota")
+def _iota(g, eqn, ins, outs):
+    """Static-shape iota: constant-folded to an initializer."""
+    p = eqn.params
+    shape, dim = p["shape"], p["dimension"]
+    rng = np.arange(shape[dim], dtype=np.dtype(p["dtype"]))
+    view = [1] * len(shape)
+    view[dim] = shape[dim]
+    arr = np.ascontiguousarray(np.broadcast_to(rng.reshape(view), shape))
+    g.node("Identity", [g.constant(arr, "iota")], outs)
+
+
+@register_onnx_lowering("gather")
+def _gather(g, eqn, ins, outs):
+    """lax.gather restricted to the take / advanced-indexing class where
+    every operand dim is either a collapsed size-1 indexed dim or a full
+    slice (jnp.take, x[idx_a, idx_b], strided fancy indexing): lowered as
+    Transpose -> GatherND -> Transpose. The general windowed gather is
+    out of scope (detection graphs never emit it)."""
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if dn.operand_batching_dims or dn.start_indices_batching_dims:
+        raise NotImplementedError("batched gather dims")
+    op_aval, idx_aval = eqn.invars[0].aval, eqn.invars[1].aval
+    ndim = len(op_aval.shape)
+    slice_sizes = p["slice_sizes"]
+    sim = list(dn.start_index_map)
+    collapsed = set(dn.collapsed_slice_dims)
+    free = [d for d in range(ndim) if d not in collapsed]
+    if not (set(sim) == collapsed
+            and all(slice_sizes[d] == 1 for d in collapsed)
+            and all(slice_sizes[d] == op_aval.shape[d] for d in free)):
+        raise NotImplementedError(
+            f"general gather {dn} slice_sizes={slice_sizes}")
+    x = ins[0]
+    perm_in = sim + free          # indexed dims first, start_index_map order
+    if perm_in != list(range(ndim)):
+        x = g.node("Transpose", [x], perm=perm_in)
+    idx = g.node("Cast", [ins[1]], to=_DTYPES[np.dtype(np.int64)])
+    # jax out-of-bounds semantics: CLIP clamps per dim; FILL (jnp.take
+    # default) returns a fill value GatherND cannot express
+    mode = str(p.get("mode", ""))
+    if "FILL" in mode:
+        raise NotImplementedError(
+            "gather mode FILL (jnp.take default); use mode='clip' or "
+            "'promise_in_bounds' in the traced function")
+    if "CLIP" in mode:
+        hi = np.asarray([op_aval.shape[d] - 1 for d in sim], np.int64)
+        idx = g.node("Max", [idx, g.constant(np.int64(0))])
+        idx = g.node("Min", [idx, g.constant(hi)])
+    gnd = g.node("GatherND", [x, idx])
+    # GatherND layout: [idx batch dims..., free dims...]; lax.gather puts
+    # free dims at offset_dims positions (operand order), idx batch dims
+    # at the remaining output positions in order
+    out_rank = len(eqn.outvars[0].aval.shape)
+    n_batch = len(idx_aval.shape) - 1
+    layout = [("b", i) for i in range(n_batch)] + [("f", d) for d in free]
+    desired, bi, fi = [], 0, 0
+    for j in range(out_rank):
+        if j in dn.offset_dims:
+            desired.append(("f", free[fi]))
+            fi += 1
+        else:
+            desired.append(("b", bi))
+            bi += 1
+    perm_out = [layout.index(t) for t in desired]
+    if perm_out != list(range(out_rank)):
+        g.node("Transpose", [gnd], outs, perm=perm_out)
+    else:
+        g.node("Identity", [gnd], outs)
+
+
+@register_onnx_lowering("top_k")
+def _top_k(g, eqn, ins, outs):
+    """lax.top_k -> ONNX TopK along the last axis (int64 indices cast
+    back to the int32 jax convention) — the postprocess candidate-select
+    step of the pre-NMS detection graphs."""
+    kc = g.constant(np.asarray([eqn.params["k"]], np.int64))
+    idx64 = g.fresh("topk_idx")
+    g.node("TopK", [ins[0], kc], [outs[0], idx64],
+           axis=-1, largest=1, sorted=1)
+    g.node("Cast", [idx64], [outs[1]], to=_DTYPES[np.dtype(np.int32)])
+
+
+@register_onnx_lowering("sort")
+def _sort(g, eqn, ins, outs):
+    """lax.sort (the jnp.sort/argsort primitive): ascending TopK over the
+    full axis; payload operands ride along via GatherElements. Tie order
+    follows ONNX TopK, not jax's stable sort — equal-key payloads may
+    permute."""
+    p = eqn.params
+    if p.get("num_keys", 1) != 1:
+        raise NotImplementedError("lexicographic multi-key sort")
+    dim = p["dimension"]
+    n = eqn.invars[0].aval.shape[dim]
+    kc = g.constant(np.asarray([n], np.int64))
+    idx64 = g.fresh("sort_idx")
+    g.node("TopK", [ins[0], kc], [outs[0], idx64],
+           axis=dim, largest=0, sorted=1)
+    for i in range(1, len(ins)):
+        g.node("GatherElements", [ins[i], idx64], [outs[i]], axis=dim)
+
+
+@register_onnx_lowering("argmax")
+def _argmax(g, eqn, ins, outs):
+    axes = eqn.params["axes"]
+    if len(axes) != 1:
+        raise NotImplementedError("multi-axis argmax")
+    a64 = g.node("ArgMax", ins, axis=int(axes[0]), keepdims=0,
+                 select_last_index=0)
+    g.node("Cast", [a64], outs,
+           to=_DTYPES[np.dtype(eqn.outvars[0].aval.dtype)])
+
+
 # ---------------------------------------------------------------- export
 
 _INLINE = ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
@@ -558,6 +672,12 @@ def _parse_tensor(blob: bytes) -> Tuple[str, np.ndarray]:
     return name, arr
 
 
+def _signed64(v: int) -> int:
+    """Protobuf int64 varints are two's complement; undo the encoder's
+    `n & (1<<64)-1` so negative attributes (axis=-1) read back signed."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _parse_attr(blob: bytes) -> Tuple[str, Any]:
     r = _Reader(blob)
     name, value, ints = "", None, []
@@ -568,11 +688,12 @@ def _parse_attr(blob: bytes) -> Tuple[str, Any]:
         elif field == 2:
             value = val
         elif field == 3:
-            value = val
+            value = _signed64(val)
         elif field == 4:
             value = val.decode()
         elif field == 8:
-            ints += _read_packed_ints(val) if wire == 2 else [val]
+            ints += ([_signed64(v) for v in _read_packed_ints(val)]
+                     if wire == 2 else [_signed64(val)])
     return name, (ints if ints else value)
 
 
@@ -711,7 +832,23 @@ def _eval_node(node: Dict[str, Any], vals: Dict[str, np.ndarray]):
         "ReduceProd": lambda: np.prod(
             x[0], axis=tuple(A["axes"]), keepdims=bool(A["keepdims"])),
         "Slice": lambda: _np_slice(x),
+        "GatherND": lambda: x[0][tuple(
+            np.asarray(x[1])[..., j] for j in range(x[1].shape[-1]))],
+        "GatherElements": lambda: np.take_along_axis(
+            x[0], np.asarray(x[1], np.int64), axis=int(A["axis"])),
+        "ArgMax": lambda: np.argmax(x[0], axis=int(A["axis"])).astype(
+            np.int64) if not int(A.get("keepdims", 1)) else np.argmax(
+            x[0], axis=int(A["axis"]), keepdims=True).astype(np.int64),
     }
+    if op == "TopK":
+        k = int(np.asarray(x[1]).reshape(-1)[0])
+        axis = int(A.get("axis", -1))
+        largest = int(A.get("largest", 1))
+        key = -x[0] if largest else x[0]
+        idx = np.argsort(key, axis=axis, kind="stable")
+        idx = np.take(idx, np.arange(k), axis=axis)
+        vals_ = np.take_along_axis(x[0], idx, axis=axis)
+        return (vals_, idx.astype(np.int64))
     if op not in simple:
         raise NotImplementedError(f"evaluator: unsupported op {op}")
     return simple[op]()
@@ -727,7 +864,12 @@ def run_onnx(graph: Dict[str, Any], *inputs: np.ndarray
     for node in graph["nodes"]:
         out = _eval_node(node, vals)
         outs = node["outputs"]
-        if len(outs) != 1:
+        if isinstance(out, tuple):
+            if len(outs) != len(out):
+                raise NotImplementedError("output arity mismatch")
+            vals.update(zip(outs, out))
+        elif len(outs) != 1:
             raise NotImplementedError("multi-output node")
-        vals[outs[0]] = out
+        else:
+            vals[outs[0]] = out
     return [vals[o] for o in graph["outputs"]]

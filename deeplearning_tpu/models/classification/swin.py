@@ -384,6 +384,11 @@ swin_moe_micro_patch2_window7 = _factory(
     "swin_moe_micro_patch2_window7", patch_size=2, embed_dim=32,
     depths=(2, 2), num_heads=(2, 4), moe=True, num_experts=4,
     drop_path_rate=0.0)
+# dense twin of the micro MoE config — the equal-size baseline for MoE
+# convergence A/B runs (VERDICT r4 #3)
+swin_micro_patch2_window7 = _factory(
+    "swin_micro_patch2_window7", patch_size=2, embed_dim=32,
+    depths=(2, 2), num_heads=(2, 4), drop_path_rate=0.0)
 # Swin-MLP variants (swin_mlp.py; configs/swin_mlp_*.yaml): cN = head dim,
 # heads per stage = stage dim / N
 swin_mlp_tiny_c24_patch4_window8_256 = _factory(

@@ -38,6 +38,11 @@ def main(argv=None) -> int:
     ap.add_argument("--format",
                     choices=("stablehlo", "savedmodel", "onnx"),
                     default="stablehlo")
+    ap.add_argument("--decode", action="store_true",
+                    help="detectors: include the box decode in the graph "
+                         "(pre-NMS raw detections, the yolov5 "
+                         "export.py:29-159 export_detect / YOLOX "
+                         "tools/export_onnx.py --decode analog)")
     ap.add_argument("--out", required=True)
     args = ap.parse_args(argv)
 
@@ -62,6 +67,28 @@ def main(argv=None) -> int:
 
     def fn(x):
         return model.apply(variables, x, train=False)
+
+    if args.decode:
+        hw = (args.size, args.size)
+        if args.model.startswith("yolox"):
+            from deeplearning_tpu.models.detection.yolox import (
+                decode_outputs, yolox_grid)
+            centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
+
+            def fn(x):
+                raw = model.apply(variables, x, train=False)
+                return decode_outputs(raw, centers, strides)
+        elif args.model.startswith("yolov5"):
+            from deeplearning_tpu.models.detection.yolov5 import (
+                decode_yolov5, yolov5_grid)
+            grid = {k: jnp.asarray(v) for k, v in yolov5_grid(hw).items()}
+
+            def fn(x):
+                raw = model.apply(variables, x, train=False)
+                return decode_yolov5(raw, grid)
+        else:
+            raise SystemExit(f"--decode not supported for {args.model!r} "
+                             "(yolox*/yolov5* only)")
 
     print(f"model FLOPs (fwd, batch {args.batch}): "
           f"{flops_estimate(fn, example) / 1e9:.2f} G")
