@@ -33,13 +33,29 @@ def _build(name: str) -> Optional[str]:
     fresh_after = max(os.path.getmtime(src), os.path.getmtime(__file__))
     if os.path.exists(out) and os.path.getmtime(out) >= fresh_after:
         return out
-    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    # compile to a private temp name, then atomically rename into place:
+    # writing the final path directly lets a CONCURRENT process dlopen a
+    # half-written .so — a startup SIGSEGV that vanishes once the cache
+    # is warm (the round-4 retinanet rc=-11 signature)
+    import glob
+    for stale in glob.glob(os.path.join(_DIR, f".lib{name}.*.tmp.so")):
+        try:                      # leftovers from a killed compile
+            os.unlink(stale)
+        except OSError:
+            pass
+    tmp = os.path.join(_DIR, f".lib{name}.{os.getpid()}.tmp.so")
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
            + _FLAGS.get(name, []))
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
         return out
-    except (subprocess.CalledProcessError, FileNotFoundError,
-            subprocess.TimeoutExpired):
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 

@@ -94,11 +94,19 @@ class MoEMlp(nn.Module):
             tokens.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
 
-        combine = jnp.zeros((t, e, capacity), jnp.float32)
+        # Scatter/gather dispatch — O(T·d + E·C·d) memory. The previous
+        # dense (T, E, C) combine/dispatch tensors are O(T²·d) because
+        # C ∝ T/E: at 56px·batch-64 (T=50k) that is terabytes — the
+        # round-4 swin_moe_cls_hard56 rc=-9 OOM. Routing semantics are
+        # unchanged: top-k argmax rounds, token-order capacity ranks,
+        # later rounds offset by earlier slot usage.
         aux = jnp.zeros((), jnp.float32)
         remaining = probs
         used = jnp.zeros((e,), jnp.float32)   # slots taken in prior rounds
         gate_sum = jnp.zeros((t,), jnp.float32)  # selected in-capacity mass
+        rounds = []                           # (choice, pos_idx, gate, keep)
+        n_assigned = jnp.zeros((), jnp.float32)
+        per_expert = jnp.zeros((e,), jnp.float32)
         for k in range(self.top_k):
             choice = jnp.argmax(remaining, axis=-1)              # (T,)
             gate = jnp.take_along_axis(remaining, choice[:, None],
@@ -109,41 +117,54 @@ class MoEMlp(nn.Module):
             # position within expert (capacity rank), in token order,
             # OFFSET by slots consumed in earlier top-k rounds so first-
             # and second-choice tokens never collide on a slot
-            pos = (jnp.cumsum(mask, axis=0) - 1.0 + used[None, :]) * mask
-            in_cap = pos < capacity
+            pos = jnp.sum((jnp.cumsum(mask, axis=0) - 1.0 + used[None, :])
+                          * mask, axis=-1)                       # (T,)
+            keep = pos < capacity                                # (T,)
             pos_idx = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
-            cap_onehot = jax.nn.one_hot(pos_idx, capacity) \
-                * (mask * in_cap)[..., None]                     # (T,E,C)
-            combine = combine + gate[:, None, None] * cap_onehot
-            gate_sum = gate_sum + gate * jnp.sum(mask * in_cap, axis=-1)
+            rounds.append((choice, pos_idx, gate, keep))
+            gate_sum = gate_sum + gate * keep
+            n_assigned = n_assigned + jnp.sum(keep, dtype=jnp.float32)
+            per_expert = per_expert + jnp.sum(
+                mask * keep[:, None], axis=0, dtype=jnp.float32)
             used = used + jnp.sum(mask, axis=0)
             remaining = remaining * (1.0 - mask)
 
-        if self.top_k > 1:
-            # tutel/swin-moe normalize the selected top-k gates to sum to 1
-            # (masked to in-capacity selections) so multi-expert outputs
-            # are not systematically down-scaled
-            combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
-
-        dispatch = (combine > 0).astype(tokens.dtype)            # (T,E,C)
         # observability: the quantities that actually go wrong in MoE
         # training (swin_transformer_moe.py:273 tunes capacity_factor
         # against exactly these) — sown per layer, harvested by the
         # trainer into step metrics
-        n_assigned = jnp.sum(dispatch, dtype=jnp.float32)
         self.sow("moe_metrics", "drop_rate",
                  1.0 - n_assigned / (t * self.top_k))
         self.sow("moe_metrics", "capacity_util",
                  n_assigned / (e * capacity))
-        per_expert = jnp.sum(dispatch, axis=(0, 2),
-                               dtype=jnp.float32)        # (E,)
         self.sow("moe_metrics", "max_expert_load",
                  jnp.max(per_expert) / jnp.maximum(
                      jnp.mean(per_expert), 1.0))
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
+
+        # build the (E, C) slot→token table by scatter (dropped tokens
+        # write to a dummy expert row e), then gather tokens into
+        # (E, C, d) expert inputs; empty slots stay zero like the dense
+        # dispatch einsum produced
+        slot_token = jnp.zeros((e + 1, capacity), jnp.int32)
+        slot_filled = jnp.zeros((e + 1, capacity), tokens.dtype)
+        for choice, pos_idx, gate, keep in rounds:
+            safe_e = jnp.where(keep, choice, e)
+            slot_token = slot_token.at[safe_e, pos_idx].set(
+                jnp.arange(t, dtype=jnp.int32))
+            slot_filled = slot_filled.at[safe_e, pos_idx].set(1.0)
+        expert_in = tokens[slot_token[:e]] * slot_filled[:e, :, None]
         expert_out = ExpertMlp(e, int(d * self.hidden_ratio), d,
                                self.dtype, name="experts")(expert_in)
-        out = jnp.einsum("tec,eco->to", combine.astype(expert_out.dtype),
-                         expert_out)
+
+        # combine: each token gathers its slot's expert output, weighted
+        # by its gate (normalized over the selected in-capacity mass for
+        # top-k > 1, the tutel/swin-moe convention)
+        out = jnp.zeros((t, d), expert_out.dtype)
+        for choice, pos_idx, gate, keep in rounds:
+            w = gate * keep
+            if self.top_k > 1:
+                w = w / jnp.maximum(gate_sum, 1e-9)
+            out = out + expert_out[choice, pos_idx] \
+                * w[:, None].astype(expert_out.dtype)
         out = nn.Dropout(self.drop, deterministic=deterministic)(out)
         return out.reshape(b, n, d), self.aux_weight * aux

@@ -1,0 +1,117 @@
+"""Cross-topology checkpoint restore (VERDICT r4 #8): an Orbax
+checkpoint written under one mesh restores onto a DIFFERENT topology —
+the robustness property a real pod needs before any resharding-restart
+story (reference analog: swin utils.py load_checkpoint accepts
+checkpoints from any DDP world size because torch.save stores full
+tensors; here the checkpoint may be sharded, so restore must reshard).
+
+Covered: DP8 (replicated params) → DP4×TP2 (Megatron TP rules) and
+DP8 → pipeline mesh (stage-stacked params sharded P('model'))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning_tpu.core.checkpoint import CheckpointManager
+from deeplearning_tpu.models.classification.vit import VisionTransformer
+from deeplearning_tpu.parallel import MeshConfig, build_mesh
+from deeplearning_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+from deeplearning_tpu.train import TrainState, shard_state
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 (virtual) devices")
+
+
+def _tiny_vit():
+    return VisionTransformer(img_size=16, patch_size=8, num_classes=4,
+                             embed_dim=32, depth=2, num_heads=2,
+                             drop_rate=0.0, attn_drop_rate=0.0,
+                             drop_path_rate=0.0, dtype=jnp.float32)
+
+
+def _state(seed: int) -> TrainState:
+    model = _tiny_vit()
+    params = model.init(jax.random.key(seed),
+                        jnp.zeros((1, 16, 16, 3)), train=False)["params"]
+    return TrainState.create(apply_fn=model.apply, params=params,
+                             tx=optax.adam(1e-3))
+
+
+def _leaves_equal(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for la, lb in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestCrossTopologyRestore:
+    def test_dp8_restores_onto_dp4_tp2(self, tmp_path):
+        mesh_dp = build_mesh(MeshConfig(data=-1))            # DP8
+        saved = shard_state(_state(0), mesh_dp)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(0, saved)
+        mgr.wait_until_finished()
+
+        mesh_tp = build_mesh(MeshConfig(data=-1, model=2))   # DP4×TP2
+        target = shard_state(_state(1), mesh_tp, TRANSFORMER_TP_RULES)
+        restored = CheckpointManager(str(tmp_path)).restore(target)
+        assert restored is not None
+
+        # values come from the checkpoint, not the seed-1 target
+        _leaves_equal(restored.params, saved.params)
+        # ... and land TP-sharded on the new mesh
+        qkv = restored.params["blocks_0"]["attn"]["qkv"]["kernel"]
+        assert not qkv.sharding.is_fully_replicated
+        assert qkv.sharding.mesh.shape["model"] == 2
+
+        # the restored state actually trains on the new topology
+        from deeplearning_tpu.parallel.sharding import batch_sharding
+        from deeplearning_tpu.train import make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+        step = make_train_step(make_loss_fn(), mesh=mesh_tp)
+        g = np.random.default_rng(0)
+        batch = {"image": jnp.asarray(g.normal(size=(8, 16, 16, 3)),
+                                      jnp.float32),
+                 "label": jnp.asarray(g.integers(0, 4, 8), jnp.int32)}
+        batch = jax.device_put(batch, batch_sharding(mesh_tp))
+        prev_step = int(restored.step)     # the step donates the state
+        new_state, metrics = step(restored, batch, jax.random.key(0))
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(new_state.step) == prev_step + 1
+
+    def test_dp8_restores_onto_pipeline_mesh(self, tmp_path):
+        from deeplearning_tpu.parallel.pipeline_train import (
+            shard_pipeline_state, split_vit_params)
+
+        model = _tiny_vit()
+        variables = model.init(jax.random.key(2),
+                               jnp.zeros((1, 16, 16, 3)), train=False)
+        outer, stages, _ = split_vit_params(variables["params"], 2)
+        pp_params = {"outer": outer, "stages": stages}
+        state = TrainState.create(apply_fn=model.apply, params=pp_params,
+                                  tx=optax.adam(1e-3))
+
+        mesh_dp = build_mesh(MeshConfig(data=-1))
+        saved = shard_state(state, mesh_dp)                  # replicated
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(0, saved)
+        mgr.wait_until_finished()
+
+        mesh_pp = build_mesh(MeshConfig(data=-1, model=2))
+        variables2 = model.init(jax.random.key(3),
+                                jnp.zeros((1, 16, 16, 3)), train=False)
+        outer2, stages2, _ = split_vit_params(variables2["params"], 2)
+        target = TrainState.create(
+            apply_fn=model.apply,
+            params={"outer": outer2, "stages": stages2},
+            tx=optax.adam(1e-3))
+        target = shard_pipeline_state(target, mesh_pp)
+        restored = CheckpointManager(str(tmp_path)).restore(target)
+        assert restored is not None
+
+        _leaves_equal(restored.params, saved.params)
+        stage_leaf = jax.tree.leaves(restored.params["stages"])[0]
+        spec = stage_leaf.sharding.spec
+        assert spec and spec[0] == "model"   # stage axis rides the pipe
