@@ -42,6 +42,23 @@ RUNS = [
         "data.val_rate=0.1", "data.global_batch=16", "train.epochs=10",
         "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
         f"train.workdir={OUT}/swin_moe"]),
+    # round-5 MoE closure (VERDICT r4 #3): the 56px 100-class run the
+    # O(T²d) dense dispatch OOM-killed in r4 (rc=-9), now feasible with
+    # the scatter/gather dispatch; dense twin = the equal-size baseline
+    ("swin_moe_cls_hard56_v2", [
+        "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard56/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=8",
+        "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/swin_moe56"]),
+    ("swin_dense_cls_hard56", [
+        "tools/train.py", "model.name=swin_micro_patch2_window7",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard56/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=64", "train.epochs=8",
+        "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/swin_dense56"]),
     ("yolox_tiny_det_hard", [
         "tools/train_detection.py", "model.name=yolox_tiny",
         "model.num_classes=10", "model.image_size=128",
@@ -112,6 +129,9 @@ def ensure_datasets() -> None:
         (f"{DATA}/cls_hard28/cls_hard.npz", npz_count, 4000,
          lambda: make_cls_hard(f"{DATA}/cls_hard28", n_images=4000,
                                size=28, seed=2)),
+        (f"{DATA}/cls_hard56/cls_hard.npz", npz_count, 8000,
+         lambda: make_cls_hard(f"{DATA}/cls_hard56", n_images=8000,
+                               size=56, seed=4)),
         (f"{DATA}/det_hard/instances.json", json_count, 4000,
          lambda: make_det_hard(f"{DATA}/det_hard", n_images=4000)),
         (f"{DATA}/seg_hard/seg_hard.npz", npz_count, 3000,
