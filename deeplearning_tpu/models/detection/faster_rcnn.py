@@ -103,27 +103,39 @@ class FasterRCNN(nn.Module):
 
     @nn.compact
     def __call__(self, images: jax.Array, proposals: Optional[jax.Array]
-                 = None, train: bool = False) -> Dict[str, Any]:
-        feats = ResNet(stage_sizes=self.backbone_sizes,
-                       return_features=True, dtype=self.dtype,
-                       frozen_bn=self.backbone_frozen_bn,
-                       name="backbone")(images, train=train)
-        pyramid = FPN(self.fpn_channels, extra_levels="pool",
-                      dtype=self.dtype, name="fpn")(feats)
-        rpn_head = RPNHead(self.anchors_per_loc, self.dtype, name="rpn")
-        obj, deltas = [], []
-        level_counts = []
-        for name in sorted(pyramid, key=lambda k: int(k[1:])):
-            o, d = rpn_head(pyramid[name])
-            obj.append(o)
-            deltas.append(d)
-            level_counts.append(o.shape[1])
-        out = {
-            "pyramid": pyramid,
-            "rpn_obj": jnp.concatenate(obj, axis=1),
-            "rpn_deltas": jnp.concatenate(deltas, axis=1),
-            "level_counts": level_counts,
-        }
+                 = None, train: bool = False,
+                 pyramid: Optional[Dict[str, jax.Array]] = None
+                 ) -> Dict[str, Any]:
+        """``pyramid``: pass the first call's ``out["pyramid"]`` to run
+        the RoI stage WITHOUT recomputing backbone+FPN+RPN — the
+        two-phase training step (rpn loss → proposals → roi loss) then
+        costs one backbone forward, not two, and BN statistics update
+        once per step (faster_rcnn.py:44 runs its single forward the
+        same way; the double-apply here was 2× backbone cost)."""
+        if pyramid is None:
+            feats = ResNet(stage_sizes=self.backbone_sizes,
+                           return_features=True, dtype=self.dtype,
+                           frozen_bn=self.backbone_frozen_bn,
+                           name="backbone")(images, train=train)
+            pyramid = FPN(self.fpn_channels, extra_levels="pool",
+                          dtype=self.dtype, name="fpn")(feats)
+            rpn_head = RPNHead(self.anchors_per_loc, self.dtype,
+                               name="rpn")
+            obj, deltas = [], []
+            level_counts = []
+            for name in sorted(pyramid, key=lambda k: int(k[1:])):
+                o, d = rpn_head(pyramid[name])
+                obj.append(o)
+                deltas.append(d)
+                level_counts.append(o.shape[1])
+            out = {
+                "pyramid": pyramid,
+                "rpn_obj": jnp.concatenate(obj, axis=1),
+                "rpn_deltas": jnp.concatenate(deltas, axis=1),
+                "level_counts": level_counts,
+            }
+        else:
+            out = {"pyramid": pyramid}
         # second stage always runs (on a dummy roi when no proposals are
         # given) so the box-head params exist under eval-mode init
         run_props = proposals if proposals is not None else \

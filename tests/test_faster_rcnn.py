@@ -127,3 +127,30 @@ class TestFasterRCNN:
         gn = np.sqrt(sum(float(jnp.sum(x ** 2))
                          for x in jax.tree.leaves(g)))
         assert np.isfinite(gn) and gn > 0
+
+
+def test_pyramid_reuse_matches_recompute():
+    """The pyramid= fast path (one backbone forward per train step) must
+    produce identical RoI outputs to the full recompute path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning_tpu.core.registry import MODELS
+
+    model = MODELS.build("fasterrcnn_resnet18_fpn", num_classes=4,
+                         dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(1, 64, 64, 3)), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    props = jnp.asarray(
+        np.random.default_rng(1).uniform(4, 60, (1, 8, 4)).astype("f4"))
+    props = jnp.concatenate([jnp.minimum(props[..., :2], props[..., 2:]),
+                             jnp.maximum(props[..., :2], props[..., 2:])],
+                            axis=-1)
+    full = model.apply(variables, x, proposals=props, train=False)
+    fast = model.apply(variables, x, proposals=props, train=False,
+                       pyramid=full["pyramid"])
+    np.testing.assert_array_equal(np.asarray(full["roi_scores"]),
+                                  np.asarray(fast["roi_scores"]))
+    np.testing.assert_array_equal(np.asarray(full["roi_deltas"]),
+                                  np.asarray(fast["roi_deltas"]))

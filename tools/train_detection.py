@@ -45,6 +45,10 @@ class DetModelCfg:
                                       # (fasterRcnn resnet50_fpn.py:5);
                                       # pair with train.freeze=backbone
                                       # for reference fine-tune semantics
+    rcnn_post_nms_top_n: int = 256    # fasterrcnn proposals kept after
+                                      # NMS (rpn_function.py post_nms_top_n)
+    rcnn_roi_batch: int = 128         # fasterrcnn sampled rois per image
+                                      # (roi_head batch_size_per_image)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,12 +122,14 @@ def synthetic_boxes(n: int, size: int, num_classes: int, max_gt: int,
 
 
 def build_task(model, name: str, num_classes: int, score_thresh: float,
-               max_det: int = 10):
+               max_det: int = 10, rcnn_kw: Optional[dict] = None):
     """Family dispatch. Returns
     (loss_fn(params, stats, batch, rng) -> (total_loss, new_stats),
      predict_fn(params, stats, images) -> padded det dict).
     The image size is read from the traced batch shape, so grids/anchors
-    are rebuilt per multi-scale bucket."""
+    are rebuilt per multi-scale bucket. ``rcnn_kw``: fasterrcnn sizing
+    (post_nms_top_n, roi_batch)."""
+    rcnn_kw = rcnn_kw or {}
 
     def apply_train(params, stats, images, **kw):
         out, mut = model.apply({"params": params, "batch_stats": stats},
@@ -224,13 +230,19 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
 
     if name.startswith("fasterrcnn"):
         # two-stage: RPN loss on the first apply, proposals sampled
-        # under stop-gradient semantics, ROI-head loss on the second
-        # apply (train_resnet50_fpn.py flow). The model's class space is
+        # under stop-gradient semantics, RoI-head loss on a second apply
+        # that REUSES the first call's pyramid (one backbone forward per
+        # step, train_resnet50_fpn.py flow). The model's class space is
         # num_classes+1 with 0 = background, so gt labels shift +1 here
         # and detections shift -1 back in predict.
         from deeplearning_tpu.models.detection.faster_rcnn import (
             fasterrcnn_anchors, fasterrcnn_postprocess,
             generate_proposals, roi_head_loss, rpn_loss, sample_rois)
+        # fall back to the DetModelCfg defaults (single source of truth
+        # for callers like demo.py that pass no rcnn_kw)
+        post_nms = rcnn_kw.get("post_nms_top_n",
+                               DetModelCfg.rcnn_post_nms_top_n)
+        roi_batch = rcnn_kw.get("roi_batch", DetModelCfg.rcnn_roi_batch)
 
         def loss_fn(params, stats, batch, rng):
             hw = batch["image"].shape[1:3]
@@ -239,23 +251,31 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             out, stats1 = apply_train(params, stats, batch["image"])
             r = rpn_loss(out, anchors, batch["boxes"], batch["valid"],
                          rng)
-            props, pvalid = generate_proposals(out, anchors, hw)
+            props, pvalid = generate_proposals(out, anchors, hw,
+                                               post_nms_top_n=post_nms)
             samples = sample_rois(
                 jax.lax.stop_gradient(props), pvalid, batch["boxes"],
-                labels1, batch["valid"], rng)
-            out2, stats2 = apply_train(params, stats1, batch["image"],
-                                       proposals=samples["rois"])
+                labels1, batch["valid"], rng,
+                batch_per_image=roi_batch)
+            # second stage on the SAME pyramid: no backbone recompute,
+            # stats1 stays the step's final batch_stats (the roi pass
+            # runs no BN)
+            out2, _ = apply_train(params, stats1, batch["image"],
+                                  proposals=samples["rois"],
+                                  pyramid=out["pyramid"])
             h = roi_head_loss(out2["roi_scores"], out2["roi_deltas"],
                               samples)
             return (r["rpn_obj_loss"] + r["rpn_reg_loss"]
-                    + h["roi_cls_loss"] + h["roi_reg_loss"], stats2)
+                    + h["roi_cls_loss"] + h["roi_reg_loss"], stats1)
 
         def predict_fn(params, stats, images):
             hw = images.shape[1:3]
             anchors = jnp.asarray(fasterrcnn_anchors(hw))
             out = apply_eval(params, stats, images)
-            props, pvalid = generate_proposals(out, anchors, hw)
-            out2 = apply_eval(params, stats, images, proposals=props)
+            props, pvalid = generate_proposals(out, anchors, hw,
+                                               post_nms_top_n=post_nms)
+            out2 = apply_eval(params, stats, images, proposals=props,
+                              pyramid=out["pyramid"])
             det = fasterrcnn_postprocess(
                 out2["roi_scores"], out2["roi_deltas"], props, hw,
                 prop_valid=pvalid, score_thresh=score_thresh, max_det=max_det)
@@ -400,10 +420,11 @@ def run(cfg) -> dict:
         model_kw["backbone_frozen_bn"] = True
     model = MODELS.build(cfg.model.name, num_classes=model_classes,
                          **model_kw)
-    loss_fn_task, predict_fn = build_task(model, cfg.model.name,
-                                          num_classes,
-                                          cfg.train.eval_score_thresh,
-                                          max_det=eval_max_det)
+    loss_fn_task, predict_fn = build_task(
+        model, cfg.model.name, num_classes, cfg.train.eval_score_thresh,
+        max_det=eval_max_det,
+        rcnn_kw=dict(post_nms_top_n=cfg.model.rcnn_post_nms_top_n,
+                     roi_batch=cfg.model.rcnn_roi_batch))
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
