@@ -20,6 +20,23 @@ import numpy as np
 _HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_history.json")
 
+# Persistent XLA compile cache: the axon tunnel can wedge mid-round, and
+# a cold ViT-B/16 train-step compile is the longest single device-holding
+# operation this script performs. Caching the serialized executable means
+# any earlier successful (or even partial) session this round makes the
+# driver's end-of-round bench compile near-instant instead of re-risking
+# the full compile inside the watchdog deadline. (Mirrored in
+# tools/bench_util.py — bench.py stays import-free of tools/ so the
+# driver's entry point cannot break if tools/ does; keep in sync.)
+_JAX_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+    pass
+
 
 def _last_good():
     """Most recent successful measurement (committed alongside the code)
@@ -48,8 +65,18 @@ def _record_good(rec):
 
 # Watchdog: the TPU tunnel in this image can wedge (hangs instead of
 # erroring). If the benchmark hasn't printed within the deadline, emit a
-# clearly-marked fallback line so the driver always records something.
+# clearly-marked fallback line so the driver always records something —
+# but do NOT kill the process at that point: killing a TPU process
+# mid-compile is itself what wedges the tunnel (observed rounds 1, 2 and
+# 5), and a slow-but-alive compile can still complete after the deadline,
+# in which case the real measurement is printed as a later line (tail
+# parsing picks it up) and lands in the persistent compile cache for the
+# next invocation. Only a much later hard deadline force-exits.
 _DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "900"))
+# Hard deadline always leaves a real grace period after the soft one,
+# even if a driver raises BENCH_DEADLINE_S past the hard default.
+_HARD_DEADLINE_S = max(int(os.environ.get("BENCH_HARD_DEADLINE_S", "3600")),
+                       _DEADLINE_S + 600)
 _PROBE_DEADLINE_S = int(os.environ.get("BENCH_PROBE_DEADLINE_S", "60"))
 _DONE = threading.Event()
 
@@ -58,10 +85,12 @@ def _watchdog():
     if not _DONE.wait(_DEADLINE_S):
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
-            "vs_baseline": 0.0, "error": "timeout: device unreachable "
-            f"within {_DEADLINE_S}s (tunnel wedge)",
+            "vs_baseline": 0.0, "error": "timeout: no result within "
+            f"{_DEADLINE_S}s (tunnel wedge?); still waiting up to "
+            f"{_HARD_DEADLINE_S}s in case the compile is merely slow",
             "last_good_run": _last_good()}), flush=True)
-        os._exit(2)
+        if not _DONE.wait(_HARD_DEADLINE_S - _DEADLINE_S):
+            os._exit(2)
 
 
 def _health_probe():

@@ -1,9 +1,24 @@
 """Shared timing helpers for the TPU microbenchmarks."""
 
+import os
 import time
 
 import jax
 import jax.numpy as jnp
+
+# Persistent XLA compile cache shared by every perf tool: a wedge-prone
+# tunnel means each completed compile should only ever be paid once per
+# round. (Mirror of the block in bench.py, which stays import-free of
+# tools/ — keep the two in sync.)
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+    pass
 
 
 def sync(x):

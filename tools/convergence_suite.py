@@ -32,16 +32,10 @@ ENV["DLTPU_PLATFORM"] = "cpu"
 ENV["JAX_PLATFORMS"] = "cpu"
 
 RUNS = [
-    # (name, argv) — model families per VERDICT #5 + the MoE curve (#10)
-    # 28px/batch-16 keeps the dense dispatch einsum (O(T^2 d), an MXU
-    # shape, brutal on one CPU core) small enough to converge offline
-    ("swin_moe_cls_hard28_e10", [
-        "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
-        "model.num_classes=100", "model.precision=f32",
-        f"data.npz={DATA}/cls_hard28/cls_hard.npz", "data.channels=3",
-        "data.val_rate=0.1", "data.global_batch=16", "train.epochs=10",
-        "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
-        f"train.workdir={OUT}/swin_moe"]),
+    # (name, argv) — model families per VERDICT r3 #5 + the MoE curve.
+    # ORDER = round-5 evidence priority: the working tree does not survive
+    # between rounds, so rows whose numbers the README already cites run
+    # first; historical r4 rows re-run last if wall-clock allows.
     # round-5 MoE closure (VERDICT r4 #3): the 56px 100-class run the
     # O(T²d) dense dispatch OOM-killed in r4 (rc=-9), now feasible with
     # the scatter/gather dispatch; dense twin = the equal-size baseline
@@ -59,18 +53,16 @@ RUNS = [
         "data.val_rate=0.1", "data.global_batch=64", "train.epochs=8",
         "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
         f"train.workdir={OUT}/swin_dense56"]),
-    ("yolox_tiny_det_hard", [
-        "tools/train_detection.py", "model.name=yolox_tiny",
-        "model.num_classes=10", "model.image_size=128",
+    # round-5 two-stage plateau (VERDICT r4 #4): shrunk config for the
+    # 1-core box — 96px, FrozenBN backbone stats, half-size proposal
+    # stage — run to a plateau instead of the r4 80-step loss demo
+    ("fasterrcnn_r18_plateau", [
+        "tools/train_detection.py", "model.name=fasterrcnn_resnet18_fpn",
+        "model.num_classes=10", "model.image_size=96",
+        "model.backbone_frozen_bn=true",
+        "model.rcnn_post_nms_top_n=128", "model.rcnn_roi_batch=64",
         f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "train.steps=700", "train.lr=0.001"]),
-    ("yolox_tiny_det_hard_mosaic", [
-        "tools/train_detection.py", "model.name=yolox_tiny",
-        "model.num_classes=10", "model.image_size=128",
-        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
-        "data.max_gt=8", "data.mosaic=true",
-        "data.random_perspective=true", "data.degrees=5",
-        "train.steps=500", "train.lr=0.001"]),
+        "data.max_gt=8", "train.steps=700", "train.lr=0.0005"]),
     # round-5 matched-budget aug comparison (VERDICT r4 #2): plain vs
     # mosaic+random_perspective with the close-mosaic schedule (last 20%
     # of steps aug-free + YOLOX L1), both 2000 steps
@@ -86,6 +78,27 @@ RUNS = [
         "data.max_gt=8", "data.mosaic=true",
         "data.random_perspective=true", "data.degrees=5",
         "train.steps=2000", "train.no_aug_steps=400", "train.lr=0.001"]),
+    # 28px/batch-16 keeps the dense dispatch einsum (O(T^2 d), an MXU
+    # shape, brutal on one CPU core) small enough to converge offline
+    ("swin_moe_cls_hard28_e10", [
+        "tools/train.py", "model.name=swin_moe_micro_patch2_window7",
+        "model.num_classes=100", "model.precision=f32",
+        f"data.npz={DATA}/cls_hard28/cls_hard.npz", "data.channels=3",
+        "data.val_rate=0.1", "data.global_batch=16", "train.epochs=10",
+        "optim.name=adamw", "optim.lr=0.002", "optim.warmup_steps=100",
+        f"train.workdir={OUT}/swin_moe"]),
+    ("yolox_tiny_det_hard", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "train.steps=700", "train.lr=0.001"]),
+    ("yolox_tiny_det_hard_mosaic", [
+        "tools/train_detection.py", "model.name=yolox_tiny",
+        "model.num_classes=10", "model.image_size=128",
+        f"data.coco={DATA}/det_hard/instances.json", "data.batch=8",
+        "data.max_gt=8", "data.mosaic=true",
+        "data.random_perspective=true", "data.degrees=5",
+        "train.steps=500", "train.lr=0.001"]),
     ("retinanet_r18_det_hard", [
         "tools/train_detection.py", "model.name=retinanet_resnet18_fpn",
         "model.num_classes=10", "model.image_size=128",

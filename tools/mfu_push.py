@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import bench_util  # noqa: F401  (side effect: persistent compile cache)
+
 
 def main():
     ap = argparse.ArgumentParser()
