@@ -14,10 +14,13 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+import bench_util  # noqa: F401  (side effect: persistent compile cache)
 
 
 def peak_flops(device) -> float:
@@ -39,29 +42,39 @@ def bf16_softmax_attention(q, k, v, dropout_rate=0.0, deterministic=True,
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
 
-def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20):
+def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
+                 model_name="vit_base_patch16_224", image_size=224,
+                 results_path=None):
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.train import TrainState, make_train_step
     from deeplearning_tpu.train.classification import make_loss_fn
     from deeplearning_tpu.train.optim import build_optimizer
     from deeplearning_tpu.train.schedules import build_schedule
 
-    model = MODELS.build("vit_base_patch16_224", num_classes=1000,
-                         attn_fn=attn_fn, remat=remat)
+    kw = {"num_classes": 1000}
+    if model_name.startswith("vit"):
+        kw.update(attn_fn=attn_fn, remat=remat)
+    model = MODELS.build(model_name, **kw)
     rng = jax.random.key(0)
-    params = model.init(rng, jnp.zeros((1, 224, 224, 3)), train=False)[
-        "params"]
+    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3)),
+                           train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
     sched = build_schedule("warmup_cosine", base_lr=1e-3,
                            total_steps=10_000, warmup_steps=100)
     tx = build_optimizer("adamw", sched, weight_decay=0.05, params=params)
-    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx,
+                              batch_stats=batch_stats)
     images = jnp.asarray(
-        np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
-        jnp.float32)
+        np.random.default_rng(0).normal(
+            size=(batch, image_size, image_size, 3)), jnp.float32)
     labels = jnp.asarray(
         np.random.default_rng(1).integers(0, 1000, batch), jnp.int32)
     data = {"image": images, "label": labels}
-    step = make_train_step(make_loss_fn(label_smoothing=0.1), donate=True)
+    step = make_train_step(
+        make_loss_fn(label_smoothing=0.1,
+                     has_batch_stats=batch_stats is not None),
+        donate=True)
     compiled = jax.jit(lambda s, b, r: step(s, b, r),
                        donate_argnums=(0,)).lower(state, data,
                                                   rng).compile()
@@ -80,6 +93,17 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20):
     mfu = step_flops / dt / peak_flops(jax.devices()[0]) * 100.0
     print(f"{name:40s} batch={batch:4d} step={dt * 1e3:8.2f}ms "
           f"img/s={batch / dt:8.1f} mfu={mfu:6.2f}%", flush=True)
+    if results_path:
+        import json
+        with open(results_path, "a") as f:
+            f.write(json.dumps({
+                "variant": name, "model": model_name, "batch": batch,
+                "step_ms": round(dt * 1e3, 2),
+                "img_per_s": round(batch / dt, 1),   # field name shared
+                "mfu_pct": round(mfu, 2),            # with mfu_push.py
+                "device": jax.devices()[0].device_kind,
+                "utc": time.strftime("%Y-%m-%d %H:%M:%S",
+                                     time.gmtime())}) + "\n")
     del state, compiled, step
     return dt, mfu
 

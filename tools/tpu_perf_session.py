@@ -4,9 +4,13 @@ process (the axon tunnel wedges if a TPU process is killed mid-compile,
 so no stage may be timeout-killed; results print incrementally with
 flush so partial progress survives a tunnel death).
 
-Stages:
+Stages (ordered so the most important number lands first if the tunnel
+wedges mid-session; every result also appends to tools/mfu_results.jsonl):
   1. health probe (fails fast if the tunnel is wedged)
   2. ViT-B/16 train-step MFU: naive vs XLA-SDPA vs flash_hb attention
+  2b. round-4 numerics-delta isolation: erf-vs-tanh GELU on the ViT
+      step, torch_pad-vs-SAME on a ResNet-50 step (VERDICT r4 #1 asked
+      for the "asserted ~0" parity-fix cost to be measured)
   3. attention kernel microbench fwd+bwd at ViT + long-context shapes
   4. Swin-B window-attention: fused kernel vs lax path
 
@@ -37,6 +41,10 @@ def stage1_probe():
           f"device={jax.devices()[0].device_kind}", flush=True)
 
 
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mfu_results.jsonl")
+
+
 def stage2_train_steps():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from perf_sweep import time_variant
@@ -49,7 +57,8 @@ def stage2_train_steps():
                      ("sdpa", sdpa_adapter),
                      ("flash_hb", flash_hb_adapter)]:
         try:
-            dt, mfu = time_variant(f"vit_train_{name}", 128, attn_fn=fn)
+            dt, mfu = time_variant(f"vit_train_{name}", 128, attn_fn=fn,
+                                   results_path=RESULTS)
             results[name] = mfu
         except Exception as e:                       # noqa: BLE001
             print(f"[train:{name}] FAILED: {e}", flush=True)
@@ -58,6 +67,46 @@ def stage2_train_steps():
         print(f"[train] best attention for ViT-B/16 step: {best} "
               f"({results[best]:.2f}% MFU)", flush=True)
     return results
+
+
+def stage2b_numerics_deltas():
+    """Isolate the MFU cost of the round-4 parity fixes.
+
+    erf-GELU: rebind flax.linen.gelu to the tanh approximation for one
+    ViT-B/16 train-step measurement (round 4 switched ViT/Swin/ConvNeXt
+    to exact erf for torch parity; cost asserted ~0, measured here). The
+    erf baseline is stage2's vit_train_naive row — the default model IS
+    exact-erf, so it is not re-measured here.
+    torch_pad: rebind the resnet module's torch_pad to XLA "SAME" for one
+    ResNet-50 measurement (round 4 switched stride-2 convs to explicit
+    torch-symmetric padding across resnet/yolox/hrnet/mobile/fpn).
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import flax.linen as fnn
+    from perf_sweep import time_variant
+    from deeplearning_tpu.models.classification import resnet as resnet_mod
+
+    orig_gelu = fnn.gelu
+    try:
+        fnn.gelu = lambda x, approximate=False: orig_gelu(
+            x, approximate=True)
+        time_variant("vit_train_gelu_tanh", 128, results_path=RESULTS)
+    except Exception as e:                           # noqa: BLE001
+        print(f"[delta:gelu] FAILED: {e}", flush=True)
+    finally:
+        fnn.gelu = orig_gelu
+
+    orig_pad = resnet_mod.torch_pad
+    try:
+        time_variant("resnet50_train_torch_pad", 128,
+                     model_name="resnet50", results_path=RESULTS)
+        resnet_mod.torch_pad = lambda k, dilation=1: "SAME"
+        time_variant("resnet50_train_same_pad", 128,
+                     model_name="resnet50", results_path=RESULTS)
+    except Exception as e:                           # noqa: BLE001
+        print(f"[delta:pad] FAILED: {e}", flush=True)
+    finally:
+        resnet_mod.torch_pad = orig_pad
 
 
 def stage3_attn_micro():
@@ -151,11 +200,14 @@ def main():
     ap.add_argument("--skip-micro", action="store_true")
     args = ap.parse_args()
     stage1_probe()
+    # train-step MFU first: it is the headline number, so it must land
+    # before a mid-session tunnel wedge can take the rest
+    if not args.skip_train_steps:
+        stage2_train_steps()
+        stage2b_numerics_deltas()
     if not args.skip_micro:
         stage3_attn_micro()
         stage4_window()
-    if not args.skip_train_steps:
-        stage2_train_steps()
 
 
 if __name__ == "__main__":
