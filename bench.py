@@ -60,6 +60,7 @@ def _record_good(rec):
         hist.append(rec)
         with open(_HISTORY, "w") as f:
             json.dump(hist[-20:], f, indent=1)
+            f.write("\n")
     except OSError:
         pass  # history is best-effort; never fail a good measurement
 

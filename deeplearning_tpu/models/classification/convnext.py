@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core import numerics
 from ...ops.padding import torch_pad
 from ...core.registry import MODELS
 from .mobile import InvertedResidual
@@ -33,7 +34,7 @@ class ConvNeXtBlock(nn.Module):
                     name="dwconv")(x)
         y = nn.LayerNorm(dtype=self.dtype, name="norm")(y)
         y = nn.Dense(4 * self.dim, dtype=self.dtype, name="pw1")(y)
-        y = nn.gelu(y, approximate=False)
+        y = numerics.gelu(y)
         y = nn.Dense(self.dim, dtype=self.dtype, name="pw2")(y)
         gamma = self.param("gamma",
                            nn.initializers.constant(self.layer_scale_init),
@@ -95,7 +96,7 @@ class CoAtNet(nn.Module):
                         name=f"stem{i}")(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              dtype=self.dtype, name=f"stem{i}_bn")(x)
-            x = nn.gelu(x, approximate=False)
+            x = numerics.gelu(x)
         # s1, s2: MBConv
         for si in (1, 2):
             for i in range(self.depths[si]):
@@ -120,7 +121,7 @@ class CoAtNet(nn.Module):
                                  name=f"s{si}_b{i}_norm2")(x)
                 y = nn.Dense(4 * self.dims[si], dtype=self.dtype,
                              name=f"s{si}_b{i}_mlp1")(y)
-                y = nn.gelu(y, approximate=False)
+                y = numerics.gelu(y)
                 y = nn.Dense(self.dims[si], dtype=self.dtype,
                              name=f"s{si}_b{i}_mlp2")(y)
                 x = x + y

@@ -1,0 +1,54 @@
+"""core/numerics.py: the trace-time exact-torch numerics mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_tpu.core import numerics
+
+
+def test_default_is_tanh_approximation():
+    assert not numerics.exact_enabled()
+    x = jnp.linspace(-4, 4, 101, dtype=jnp.float32)
+    got = numerics.gelu(x)
+    import flax.linen as nn
+    np.testing.assert_array_equal(got, nn.gelu(x, approximate=True))
+
+
+def test_exact_context_selects_erf_and_restores():
+    import flax.linen as nn
+    x = jnp.linspace(-4, 4, 101, dtype=jnp.float32)
+    with numerics.exact_numerics():
+        assert numerics.exact_enabled()
+        np.testing.assert_array_equal(numerics.gelu(x),
+                                      nn.gelu(x, approximate=False))
+    assert not numerics.exact_enabled()
+    # the two flavors agree to ~1e-3 — why the fast default is safe
+    diff = np.abs(np.asarray(nn.gelu(x, approximate=True))
+                  - np.asarray(nn.gelu(x, approximate=False)))
+    assert 0 < diff.max() < 2e-3
+
+
+def test_vit_mlp_honors_mode():
+    """The model's traced computation differs between modes (and only
+    there): same params, different activation flavor."""
+    from deeplearning_tpu.models.classification.vit import Mlp
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 7, 16)),
+                    jnp.float32)
+    mlp = Mlp(hidden_ratio=2.0, dtype=jnp.float32)
+    variables = mlp.init(jax.random.key(0), x)
+    fast = mlp.apply(variables, x)
+    with numerics.exact_numerics():
+        exact = mlp.apply(variables, x)
+    assert not np.array_equal(np.asarray(fast), np.asarray(exact))
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(exact),
+                               atol=5e-3)
+
+
+def test_set_exact_process_wide():
+    numerics.set_exact(True)
+    try:
+        assert numerics.exact_enabled()
+    finally:
+        numerics.set_exact(False)
+    assert not numerics.exact_enabled()

@@ -21,6 +21,32 @@ except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
     pass
 
 
+def append_result(path, variant, *, batch, step_ms, img_per_s, mfu_pct,
+                  **extra):
+    """Append one measurement to mfu_results.jsonl (single shared schema
+    for perf_sweep.py and mfu_push.py rows).
+
+    Stamps the fields every consumer needs to interpret a row — device,
+    UTC time, and the GELU numerics mode (rows before/after the round-5
+    tanh-default switch differ by ~3.8 MFU points on ViT)."""
+    import json
+
+    from deeplearning_tpu.core import numerics
+    rec = {
+        "variant": variant,
+        "batch": batch,
+        "step_ms": round(step_ms, 2),
+        "img_per_s": round(img_per_s, 1),
+        "mfu_pct": round(mfu_pct, 2),
+        "gelu": "erf" if numerics.exact_enabled() else "tanh",
+        "device": jax.devices()[0].device_kind,
+        "utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    }
+    rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def sync(x):
     # D2H scalar fetch — block_until_ready is unreliable on this
     # remote-tunnel backend; a host fetch always syncs. Accepts any

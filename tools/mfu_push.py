@@ -124,25 +124,26 @@ def main():
         ref_flops = ref_cache[ref_key] * batch
     else:  # no naive run measured yet on this machine
         ref_flops = 1.3543e13 * batch / 128.0  # batch-128 measurement, r2
-    rec = {
-        "variant": args.tag or args.attn,
+    from bench_util import append_result
+    extra = {
         "attn": args.attn,
-        "batch": batch,
         "remat": args.remat,
         "head_block": args.head_block if args.attn == "flash_hb" else None,
-        "mfu_pct": round(step_flops / dt / peak * 100.0, 2),
         "mfu_ref_pct": round(ref_flops / dt / peak * 100.0, 2),
-        "img_per_s": round(batch / dt, 1),
-        "step_ms": round(dt * 1e3, 2),
         "compile_s": round(compile_s, 1),
         "flops_per_step": step_flops,
         "loss0": round(loss0, 4), "loss1": round(loss1, 4),
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
     }
-    print(json.dumps(rec), flush=True)
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "mfu_results.jsonl"), "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    print(json.dumps({"variant": args.tag or args.attn, "batch": batch,
+                      "step_ms": round(dt * 1e3, 2),
+                      "mfu_pct": round(step_flops / dt / peak * 100.0, 2),
+                      **extra}), flush=True)
+    append_result(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "mfu_results.jsonl"),
+                  args.tag or args.attn, batch=batch, step_ms=dt * 1e3,
+                  img_per_s=batch / dt,
+                  mfu_pct=step_flops / dt / peak * 100.0, **extra)
 
 
 if __name__ == "__main__":

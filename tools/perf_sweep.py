@@ -94,16 +94,9 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
     print(f"{name:40s} batch={batch:4d} step={dt * 1e3:8.2f}ms "
           f"img/s={batch / dt:8.1f} mfu={mfu:6.2f}%", flush=True)
     if results_path:
-        import json
-        with open(results_path, "a") as f:
-            f.write(json.dumps({
-                "variant": name, "model": model_name, "batch": batch,
-                "step_ms": round(dt * 1e3, 2),
-                "img_per_s": round(batch / dt, 1),   # field name shared
-                "mfu_pct": round(mfu, 2),            # with mfu_push.py
-                "device": jax.devices()[0].device_kind,
-                "utc": time.strftime("%Y-%m-%d %H:%M:%S",
-                                     time.gmtime())}) + "\n")
+        from bench_util import append_result
+        append_result(results_path, name, batch=batch, step_ms=dt * 1e3,
+                      img_per_s=batch / dt, mfu_pct=mfu, model=model_name)
     del state, compiled, step
     return dt, mfu
 

@@ -72,29 +72,26 @@ def stage2_train_steps():
 def stage2b_numerics_deltas():
     """Isolate the MFU cost of the round-4 parity fixes.
 
-    erf-GELU: rebind flax.linen.gelu to the tanh approximation for one
-    ViT-B/16 train-step measurement (round 4 switched ViT/Swin/ConvNeXt
-    to exact erf for torch parity; cost asserted ~0, measured here). The
-    erf baseline is stage2's vit_train_naive row — the default model IS
-    exact-erf, so it is not re-measured here.
+    erf-GELU: measure one ViT-B/16 train step under
+    ``numerics.exact_numerics()`` (erf, the torch-parity flavor). Since
+    round 5 the DEFAULT is the tanh approximation, so stage2's
+    vit_train_naive row is the tanh baseline and this is the erf variant.
+    First measured 2026-07-31: erf 47.94% vs tanh 51.71% MFU (−3.8 pts),
+    which is why the default flipped.
     torch_pad: rebind the resnet module's torch_pad to XLA "SAME" for one
     ResNet-50 measurement (round 4 switched stride-2 convs to explicit
     torch-symmetric padding across resnet/yolox/hrnet/mobile/fpn).
     """
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import flax.linen as fnn
     from perf_sweep import time_variant
+    from deeplearning_tpu.core import numerics
     from deeplearning_tpu.models.classification import resnet as resnet_mod
 
-    orig_gelu = fnn.gelu
     try:
-        fnn.gelu = lambda x, approximate=False: orig_gelu(
-            x, approximate=True)
-        time_variant("vit_train_gelu_tanh", 128, results_path=RESULTS)
+        with numerics.exact_numerics():
+            time_variant("vit_train_gelu_erf", 128, results_path=RESULTS)
     except Exception as e:                           # noqa: BLE001
         print(f"[delta:gelu] FAILED: {e}", flush=True)
-    finally:
-        fnn.gelu = orig_gelu
 
     orig_pad = resnet_mod.torch_pad
     try:

@@ -38,6 +38,7 @@ class ModelCfg:
     name: str = "mnist_cnn"
     num_classes: int = 10
     precision: str = "bf16"          # bf16 | f32
+    exact_gelu: bool = False         # erf GELU (torch parity; −3.8 MFU)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +184,9 @@ def main(argv=None) -> int:
                                     labels[order[n_val:]])
         n_train = len(tr_images)
     dtype = jnp.bfloat16 if cfg.model.precision == "bf16" else jnp.float32
+    if cfg.model.exact_gelu:
+        from deeplearning_tpu.core import numerics
+        numerics.set_exact(True)
     model_kw = {}
     if cfg.train.seq_parallel not in ("ring", "ulysses"):
         raise ValueError(
