@@ -52,18 +52,45 @@ class DropPath(nn.Module):
 
 
 class PatchEmbed(nn.Module):
-    """Image → patch tokens via a strided conv (vit_model.py:43)."""
+    """Image → patch tokens (vit_model.py:43).
+
+    The reference's strided conv IS a block reshape + matmul; lowering it
+    explicitly that way measures +1.2 MFU points on the v5e ViT-B/16 train
+    step vs XLA's conv path (52.03% vs 50.87%, tools/mfu_results.jsonl
+    patch_matmul_b128). Params keep the conv's HWIO kernel shape
+    (p, p, c, embed) and "proj" naming, so checkpoints and torch-weight
+    ports are unaffected — the kernel is reshaped at trace time."""
     patch_size: int = 16
     embed_dim: int = 768
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.embed_dim, (self.patch_size, self.patch_size),
-                    strides=(self.patch_size, self.patch_size),
-                    dtype=self.dtype, name="proj")(x)
-        b, h, w, c = x.shape
-        return x.reshape(b, h * w, c)
+        p = self.patch_size
+        b, hh, ww, c = x.shape
+        h, w = hh // p, ww // p
+        x = x.reshape(b, h, p, w, p, c).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(b, h * w, p * p * c)
+        return _PatchProj(p, c, self.embed_dim, self.dtype, name="proj")(x)
+
+
+class _PatchProj(nn.Module):
+    """Conv-shaped (HWIO) params applied as a flat matmul (PatchEmbed)."""
+    patch_size: int
+    in_chans: int
+    embed_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        p, c = self.patch_size, self.in_chans
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (p, p, c, self.embed_dim), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.embed_dim,), jnp.float32)
+        y = x.astype(self.dtype) @ kernel.reshape(
+            p * p * c, self.embed_dim).astype(self.dtype)
+        return y + bias.astype(self.dtype)
 
 
 def dot_product_attention(q, k, v, dropout_rate=0.0, deterministic=True,

@@ -109,7 +109,10 @@ def main():
     # variants compare on the same semantic workload. The per-image value
     # is measured by the naive non-remat run and cached in a sidecar keyed
     # by config, so it can't silently go stale when the config changes.
-    ref_key = "vit_base_patch16_224/img224"
+    # batch is part of the key: XLA's compiled FLOPs per image differ by
+    # ~11% between batch 128 and 512 (fusion decisions), so a batch-free
+    # key would let the last naive run poison other batches' mfu_ref_pct
+    ref_key = f"vit_base_patch16_224/img224/b{batch}"
     ref_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "mfu_ref_flops.json")
     ref_cache = {}

@@ -9,6 +9,7 @@ comparable. Run on the real chip: `python tools/perf_sweep.py`.
 """
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -40,6 +41,54 @@ def bf16_softmax_attention(q, k, v, dropout_rate=0.0, deterministic=True,
     attn = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
     attn = jax.nn.softmax(attn, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+
+class _ConvPatchEmbed:
+    """Lazily-defined stand-in: ViT's ORIGINAL strided-conv patch embed.
+
+    Since round 5 `vit.PatchEmbed` lowers the patch conv as reshape+matmul
+    (measured +1.2 MFU points); this restores the conv lowering so the
+    A/B in ``--set r5`` stays reproducible. Defined via a factory because
+    flax modules must be real classes at module scope for param binding."""
+
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is None:
+            from typing import Any
+
+            import flax.linen as nn
+            import jax.numpy as jnp
+
+            class ConvPatchEmbed(nn.Module):
+                patch_size: int = 16
+                embed_dim: int = 768
+                dtype: Any = jnp.bfloat16
+
+                @nn.compact
+                def __call__(self, x):
+                    x = nn.Conv(self.embed_dim,
+                                (self.patch_size, self.patch_size),
+                                strides=(self.patch_size, self.patch_size),
+                                dtype=self.dtype, name="proj")(x)
+                    b, h, w, c = x.shape
+                    return x.reshape(b, h * w, c)
+
+            cls._cls = ConvPatchEmbed
+        return cls._cls
+
+
+@contextlib.contextmanager
+def patch_embed_as_conv():
+    """Swap ViT back to the conv patch-embed lowering (the pre-r5 path)."""
+    from deeplearning_tpu.models.classification import vit as vit_mod
+    orig = vit_mod.PatchEmbed
+    vit_mod.PatchEmbed = _ConvPatchEmbed.get()
+    try:
+        yield
+    finally:
+        vit_mod.PatchEmbed = orig
 
 
 def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
@@ -104,19 +153,32 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
-                    choices=["batch", "attn", "all"])
+                    choices=["batch", "attn", "all", "r5"])
     args = ap.parse_args()
 
-    from deeplearning_tpu.ops.attention import flash_attn_adapter
-
+    results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "mfu_results.jsonl")
     if args.set in ("batch", "all"):
         for batch in (128, 160, 192, 256):
             time_variant("naive_f32softmax", batch)
     if args.set in ("attn", "all"):
+        from deeplearning_tpu.ops.attention import flash_attn_adapter
         time_variant("bf16_softmax", 128, attn_fn=bf16_softmax_attention)
         time_variant("bf16_softmax", 256, attn_fn=bf16_softmax_attention)
         time_variant("flash_pallas", 128, attn_fn=flash_attn_adapter)
         time_variant("flash_pallas", 256, attn_fn=flash_attn_adapter)
+    if args.set == "r5":
+        # round-5 single-chip MFU pushes on the ViT-B/16 step. The
+        # DEFAULT model is now tanh-GELU + matmul patch embed, so the
+        # naive row is the fast path and the context restores the conv
+        # for the A/B (first measured 2026-07-31: conv 50.87% vs matmul
+        # 52.03%; bf16 softmax REGRESSES to 48.52% — f32 upcast fuses
+        # better than bf16 exp)
+        time_variant("patch_matmul_b128", 128, results_path=results)
+        time_variant("bf16_softmax_b128", 128,
+                     attn_fn=bf16_softmax_attention, results_path=results)
+        with patch_embed_as_conv():
+            time_variant("patch_conv_b128", 128, results_path=results)
 
 
 if __name__ == "__main__":
