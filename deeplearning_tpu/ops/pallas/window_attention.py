@@ -41,21 +41,25 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float):
-    # blocks: q/k/v (WB, heads, Np, d); bias (WB, heads, Np, Np)
-    q = q_ref[...]
-    k = k_ref[...]
-    v = v_ref[...]
+    # blocks: q/k/v (WB, heads, Np, d); bias (WB, heads, Np, Np).
+    # (WB, heads) collapse to ONE batch dim for the dots — Mosaic's
+    # tpu.matmul supports at most one batch dim (leading-dim reshapes are
+    # layout no-ops in VMEM, so this costs nothing)
+    wb, h, npad, d = q_ref.shape
+    q = q_ref[...].reshape(wb * h, npad, d)
+    k = k_ref[...].reshape(wb * h, npad, d)
+    v = v_ref[...].reshape(wb * h, npad, d)
     s = jax.lax.dot_general(
-        q, k, (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=jnp.float32)          # (WB, heads, Np, Np)
-    s = s * scale + bias_ref[...]
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)          # (WB*heads, Np, Np)
+    s = s * scale + bias_ref[...].reshape(wb * h, npad, npad)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)
-    o_ref[...] = o.astype(o_ref.dtype)
+    o_ref[...] = o.reshape(wb, h, npad, d).astype(o_ref.dtype)
 
 
 def window_attention(qkv: jax.Array, bias: jax.Array,

@@ -153,7 +153,7 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
-                    choices=["batch", "attn", "all", "r5"])
+                    choices=["batch", "attn", "all", "r5", "decomp"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -179,6 +179,41 @@ def main():
                      attn_fn=bf16_softmax_attention, results_path=results)
         with patch_embed_as_conv():
             time_variant("patch_conv_b128", 128, results_path=results)
+    if args.set == "decomp":
+        # empirical step-time decomposition (ceiling analysis): replace a
+        # subsystem with identity and read the step-time delta vs the
+        # full model. FLOPs drop too, so compare step_ms, not mfu_pct.
+        time_variant("decomp_full", 128, results_path=results)
+        time_variant("decomp_attn_identity", 128,
+                     attn_fn=lambda q, k, v, **_: v, results_path=results)
+
+        def scores_only(q, k, v, **_):
+            # QK^T + softmax + AV with no f32 upcast and no scaling:
+            # isolates the materialized-scores HBM cost vs numerics cost
+            attn = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            attn = jax.nn.softmax(attn, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+        time_variant("decomp_attn_bf16_noscale", 128, attn_fn=scores_only,
+                     results_path=results)
+
+        def padded_attn(q, k, v, **_):
+            # pad N 197→256 inside attention only: aligned MXU tiles at
+            # the cost of +69% attention FLOPs (a tiny absolute number)
+            n = q.shape[1]
+            pad = (-n) % 128
+            padw = ((0, 0), (0, pad), (0, 0), (0, 0))
+            qp, kp, vp = (jnp.pad(t, padw) for t in (q, k, v))
+            scale = q.shape[-1] ** -0.5
+            attn = jnp.einsum("bqhd,bkhd->bhqk", qp * scale, kp)
+            mask = jnp.arange(kp.shape[1]) < n
+            attn = jnp.where(mask[None, None, None, :], attn, -jnp.inf)
+            attn = jax.nn.softmax(attn.astype(jnp.float32),
+                                  axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", attn, vp)[:, :n]
+
+        time_variant("decomp_attn_pad256", 128, attn_fn=padded_attn,
+                     results_path=results)
 
 
 if __name__ == "__main__":
