@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Append platform-tagged rows for the round-5 on-chip convergence runs
+to runs/convergence/results.jsonl (same schema as convergence_suite.py,
+plus a "platform" field; the suite's own rows are implicitly cpu)."""
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "runs", "convergence")
+
+RUNS = [  # (name, log file, platform)
+    ("resnet18_cls_hard_tpu", "resnet18_cls_hard_tpu.log"),
+    ("swin_dense56_tpu", "swin_dense56_tpu.log"),
+    ("swin_moe56_tpu", "swin_moe56_tpu.log"),
+    ("yolox_tiny_det_hard_2k_tpu", "yolox_tiny_det_hard_2k_tpu.log"),
+    ("fasterrcnn_r18_plateau_tpu", "fasterrcnn_r18_plateau_tpu.log"),
+    ("swin_diag_lr5e4", "swin_diag_lr5e4.log"),
+    ("swin_diag_lr2e3_light", "swin_diag_lr2e3_light.log"),
+    ("swin_diag_lr5e4_light", "swin_diag_lr5e4_light.log"),
+    ("swin_diag_lr1e3_light_w300", "swin_diag_lr1e3_light_w300.log"),
+    ("swin_diag_e40", "swin_diag_e40.log"),
+    ("swin_moe_e40", "swin_moe_e40.log"),
+]
+
+
+def main():
+    path = os.path.join(OUT, "results.jsonl")
+    have = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            have = {json.loads(l)["name"] for l in f if l.strip()}
+    added = 0
+    with open(path, "a") as out:
+        for name, log in RUNS:
+            if name in have:
+                continue
+            lp = os.path.join(OUT, log)
+            if not os.path.exists(lp):
+                continue
+            lines = [l.strip() for l in open(lp, errors="replace")
+                     if l.strip() and "WARNING" not in l]
+            if not lines:
+                continue
+            final = lines[-1]
+            if not re.match(r"^\{.*\}$", final):
+                continue  # run not finished yet
+            out.write(json.dumps({
+                "name": name, "rc": 0, "platform": "tpu-v5e",
+                "final": final, "log": f"runs/convergence/{log}"}) + "\n")
+            added += 1
+    print(f"appended {added} rows")
+
+
+if __name__ == "__main__":
+    main()
