@@ -293,6 +293,12 @@ class SwinTransformer(nn.Module):
     moe: bool = False                 # MoE MLP in every 2nd block
     num_experts: int = 8
     spatial_mlp: bool = False         # Swin-MLP (swin_mlp.py) blocks
+    ape: bool = False                 # absolute position embedding
+    # (swin_transformer.py:516-533). Swin's only position signal is the
+    # window-RELATIVE bias + merging hierarchy; tasks whose label depends
+    # on absolute layout (e.g. the ordered digit-pair hard set, where
+    # ResNet learns via conv zero-padding leakage but swin flatlines —
+    # runs/convergence/swin_diag_*) need this on.
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -304,6 +310,11 @@ class SwinTransformer(nn.Module):
         b, h, w, c = x.shape
         x = x.reshape(b, h * w, c)
         x = nn.LayerNorm(dtype=self.dtype, name="patch_norm")(x)
+        if self.ape:
+            pos = self.param("absolute_pos_embed",
+                             nn.initializers.truncated_normal(0.02),
+                             (1, h * w, c), jnp.float32)
+            x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.drop_rate, deterministic=deterministic)(x)
 
         total_depth = sum(self.depths)
@@ -341,8 +352,12 @@ class SwinTransformer(nn.Module):
                 dim *= 2
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         x = jnp.mean(x, axis=1)
+        # trunc-normal head like the reference (swin_transformer.py:564-566,
+        # ALL Linears std=.02). Zero-init left logits identically zero at
+        # init, so backbone grads were zero until the head moved — the
+        # 100-class flatline root cause (runs/convergence/swin_diag_*).
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head",
-                     kernel_init=nn.initializers.zeros)(x)
+                     kernel_init=nn.initializers.truncated_normal(0.02))(x)
         return x.astype(jnp.float32)
 
 
@@ -389,6 +404,27 @@ swin_moe_micro_patch2_window7 = _factory(
 swin_micro_patch2_window7 = _factory(
     "swin_micro_patch2_window7", patch_size=2, embed_dim=32,
     depths=(2, 2), num_heads=(2, 4), drop_path_rate=0.0)
+# 3-stage 56px configs (28->14->7 token grids): the micro 2-stage/dim-32
+# pair flatlines on the 100-class hard set at every LR/schedule tested
+# (r5 diag matrix, runs/convergence/swin_diag_*) while ResNet-18 reaches
+# 0.9 — capacity, not optimization; these are the smallest swin shapes
+# that actually learn the set
+swin_mini_patch2_window7 = _factory(
+    "swin_mini_patch2_window7", patch_size=2, embed_dim=64,
+    depths=(2, 2, 4), num_heads=(2, 4, 8), drop_path_rate=0.0)
+swin_moe_mini_patch2_window7 = _factory(
+    "swin_moe_mini_patch2_window7", patch_size=2, embed_dim=64,
+    depths=(2, 2, 4), num_heads=(2, 4, 8), moe=True, num_experts=4,
+    drop_path_rate=0.0)
+# +APE twins: the ordered-pair task is position-dependent (see the ape
+# field comment); these are the configs that learn it
+swin_mini_patch2_window7_ape = _factory(
+    "swin_mini_patch2_window7_ape", patch_size=2, embed_dim=64,
+    depths=(2, 2, 4), num_heads=(2, 4, 8), drop_path_rate=0.0, ape=True)
+swin_moe_mini_patch2_window7_ape = _factory(
+    "swin_moe_mini_patch2_window7_ape", patch_size=2, embed_dim=64,
+    depths=(2, 2, 4), num_heads=(2, 4, 8), moe=True, num_experts=4,
+    drop_path_rate=0.0, ape=True)
 # Swin-MLP variants (swin_mlp.py; configs/swin_mlp_*.yaml): cN = head dim,
 # heads per stage = stage dim / N
 swin_mlp_tiny_c24_patch4_window8_256 = _factory(

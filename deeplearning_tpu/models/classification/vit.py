@@ -232,8 +232,12 @@ class VisionTransformer(nn.Module):
             x = nn.Dense(self.representation_size, dtype=self.dtype,
                          name="pre_logits")(x)
             x = nn.tanh(x)
+        # trunc-normal head like the reference (vit_model.py:276-278, ALL
+        # Linears std=.01). A zero-init head makes every backbone gradient
+        # zero until the head moves — measured as a hard flatline on the
+        # 100-class from-scratch runs (runs/convergence/swin_diag_*).
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head",
-                     kernel_init=nn.initializers.zeros)(x)
+                     kernel_init=nn.initializers.truncated_normal(0.01))(x)
         return x.astype(jnp.float32)
 
 
@@ -252,6 +256,11 @@ def _factory(name, **defaults):
 vit_small_patch16_224 = _factory("vit_small_patch16_224",
                                  patch_size=16, embed_dim=384, depth=12,
                                  num_heads=6)
+# small-image config (56px offline sets: 14x14 tokens); also the
+# transformer control for the swin convergence diagnosis (r5)
+vit_micro_patch4_56 = _factory("vit_micro_patch4_56",
+                               patch_size=4, embed_dim=128, depth=6,
+                               num_heads=4, drop_path_rate=0.0)
 vit_base_patch16_224 = _factory("vit_base_patch16_224",
                                 patch_size=16, embed_dim=768, depth=12,
                                 num_heads=12)

@@ -11,7 +11,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "runs", "convergence")
 
-RUNS = [  # (name, log file, platform)
+RUNS = [  # (name, log file) — platform stamped "tpu-v5e" below
     ("resnet18_cls_hard_tpu", "resnet18_cls_hard_tpu.log"),
     ("swin_dense56_tpu", "swin_dense56_tpu.log"),
     ("swin_moe56_tpu", "swin_moe56_tpu.log"),

@@ -50,7 +50,7 @@ def main() -> int:
             final = m.group(0)
         elif e["rc"] != 0:
             final = "(failed)"
-        print(f"| {e['name']} | {e['rc']} | {e['minutes']} "
+        print(f"| {e['name']} | {e['rc']} | {e.get('minutes', '-')} "
               f"| `{final[:160]}` |")
     for e in entries:
         curve = curve_from_log(os.path.join(OUT, f"{e['name']}.log"))
