@@ -1,0 +1,34 @@
+"""Regression: classifier logits must NOT be identically zero at init.
+
+Round 5 found ViT/Swin heads were kernel_init=zeros (unlike the
+reference, which trunc-normal-inits every Linear): logits were exactly
+zero at init, so every backbone gradient was zero until the head moved
+— a hard flatline on 100-class from-scratch training that survived
+every LR/schedule sweep (runs/convergence/swin_diag_*). This pins the
+fixed behavior across the transformer families that had the bug plus a
+conv control.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+
+CASES = [
+    ("swin_micro_patch2_window7", 56),
+    ("swin_mini_patch2_window7_ape", 56),
+    ("vit_micro_patch4_56", 56),
+    ("resnet18", 56),
+]
+
+
+@pytest.mark.parametrize("name,size", CASES)
+def test_init_logits_nonzero(name, size):
+    m = MODELS.build(name, num_classes=100, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, size, size, 3)),
+                    jnp.float32)
+    v = m.init(jax.random.key(0), x, train=False)
+    out = np.asarray(m.apply(v, x, train=False))
+    assert np.abs(out).max() > 1e-4, f"{name} logits are ~zero at init"
