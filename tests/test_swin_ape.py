@@ -23,13 +23,12 @@ def test_ape_param_created_and_used():
     assert "absolute_pos_embed" in v["params"]
     assert v["params"]["absolute_pos_embed"].shape == (1, 28 * 28, 64)
     base = m.apply(v, x, train=False)
-    noise = np.random.default_rng(1).normal(
-        0, 1.0, v["params"]["absolute_pos_embed"].shape).astype(np.float32)
     # random (not constant!) perturbation — a constant offset would be
     # erased by the first LayerNorm downstream
-    shifted = jax.tree_util.tree_map_with_path(
-        lambda p, a: a + noise if "absolute_pos_embed" in jax.tree_util.keystr(p)
-        else a, v["params"])
+    noise = np.random.default_rng(1).normal(
+        0, 1.0, v["params"]["absolute_pos_embed"].shape).astype(np.float32)
+    shifted = dict(v["params"])
+    shifted["absolute_pos_embed"] = shifted["absolute_pos_embed"] + noise
     moved = m.apply({"params": shifted}, x, train=False)
     assert not np.allclose(np.asarray(base), np.asarray(moved))
 

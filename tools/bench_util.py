@@ -1,5 +1,6 @@
 """Shared timing helpers for the TPU microbenchmarks."""
 
+import json
 import os
 import time
 
@@ -28,9 +29,8 @@ def append_result(path, variant, *, batch, step_ms, img_per_s, mfu_pct,
 
     Stamps the fields every consumer needs to interpret a row — device,
     UTC time, and the GELU numerics mode (rows before/after the round-5
-    tanh-default switch differ by ~3.8 MFU points on ViT)."""
-    import json
-
+    tanh-default switch differ by ~3.8 MFU points on ViT). Returns the
+    record so callers can print exactly what was written."""
     from deeplearning_tpu.core import numerics
     rec = {
         "variant": variant,
@@ -45,6 +45,7 @@ def append_result(path, variant, *, batch, step_ms, img_per_s, mfu_pct,
     rec.update(extra)
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")
+    return rec
 
 
 def sync(x):

@@ -138,15 +138,13 @@ def main():
         "loss0": round(loss0, 4), "loss1": round(loss1, 4),
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
     }
-    print(json.dumps({"variant": args.tag or args.attn, "batch": batch,
-                      "step_ms": round(dt * 1e3, 2),
-                      "mfu_pct": round(step_flops / dt / peak * 100.0, 2),
-                      **extra}), flush=True)
-    append_result(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "mfu_results.jsonl"),
-                  args.tag or args.attn, batch=batch, step_ms=dt * 1e3,
-                  img_per_s=batch / dt,
-                  mfu_pct=step_flops / dt / peak * 100.0, **extra)
+    rec = append_result(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "mfu_results.jsonl"),
+        args.tag or args.attn, batch=batch, step_ms=dt * 1e3,
+        img_per_s=batch / dt,
+        mfu_pct=step_flops / dt / peak * 100.0, **extra)
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

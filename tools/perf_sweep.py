@@ -17,6 +17,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from typing import Any
+
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -43,40 +46,23 @@ def bf16_softmax_attention(q, k, v, dropout_rate=0.0, deterministic=True,
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
 
-class _ConvPatchEmbed:
-    """Lazily-defined stand-in: ViT's ORIGINAL strided-conv patch embed.
+class _ConvPatchEmbed(nn.Module):
+    """ViT's ORIGINAL strided-conv patch embed.
 
     Since round 5 `vit.PatchEmbed` lowers the patch conv as reshape+matmul
     (measured +1.2 MFU points); this restores the conv lowering so the
-    A/B in ``--set r5`` stays reproducible. Defined via a factory because
-    flax modules must be real classes at module scope for param binding."""
+    A/B in ``--set r5`` stays reproducible."""
+    patch_size: int = 16
+    embed_dim: int = 768
+    dtype: Any = jnp.bfloat16
 
-    _cls = None
-
-    @classmethod
-    def get(cls):
-        if cls._cls is None:
-            from typing import Any
-
-            import flax.linen as nn
-            import jax.numpy as jnp
-
-            class ConvPatchEmbed(nn.Module):
-                patch_size: int = 16
-                embed_dim: int = 768
-                dtype: Any = jnp.bfloat16
-
-                @nn.compact
-                def __call__(self, x):
-                    x = nn.Conv(self.embed_dim,
-                                (self.patch_size, self.patch_size),
-                                strides=(self.patch_size, self.patch_size),
-                                dtype=self.dtype, name="proj")(x)
-                    b, h, w, c = x.shape
-                    return x.reshape(b, h * w, c)
-
-            cls._cls = ConvPatchEmbed
-        return cls._cls
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.embed_dim, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    dtype=self.dtype, name="proj")(x)
+        b, h, w, c = x.shape
+        return x.reshape(b, h * w, c)
 
 
 @contextlib.contextmanager
@@ -84,7 +70,7 @@ def patch_embed_as_conv():
     """Swap ViT back to the conv patch-embed lowering (the pre-r5 path)."""
     from deeplearning_tpu.models.classification import vit as vit_mod
     orig = vit_mod.PatchEmbed
-    vit_mod.PatchEmbed = _ConvPatchEmbed.get()
+    vit_mod.PatchEmbed = _ConvPatchEmbed
     try:
         yield
     finally:
