@@ -25,17 +25,22 @@ _HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # operation this script performs. Caching the serialized executable means
 # any earlier successful (or even partial) session this round makes the
 # driver's end-of-round bench compile near-instant instead of re-risking
-# the full compile inside the watchdog deadline. (Mirrored in
-# tools/bench_util.py — bench.py stays import-free of tools/ so the
-# driver's entry point cannot break if tools/ does; keep in sync.)
+# the full compile inside the watchdog deadline. The canonical wiring is
+# deeplearning_tpu.core.compile_cache; bench.py delegates when that
+# import succeeds but keeps an inline fallback so the driver's entry
+# point cannot break if the package does.
 _JAX_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".jax_cache")
 try:
-    jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
-    pass
+    from deeplearning_tpu.core.compile_cache import enable_compile_cache
+    enable_compile_cache(_JAX_CACHE)
+except Exception:  # noqa: BLE001 - fall back to the inline wiring
+    try:
+        jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 - cache is never fatal
+        pass
 
 
 def _last_good():
@@ -172,6 +177,8 @@ def main():
     ).lower(state, data, rng)
     compiled = lowered.compile()
     cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):   # older JAX: list of dicts
+        cost = cost[0] if cost else {}
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
     # warmup (also materializes donation) then timed steps, driving the

@@ -1,2 +1,3 @@
 from . import config, experiment, logging, precision, registry, rng  # noqa: F401
+from .compile_cache import active_cache_dir, enable_compile_cache  # noqa: F401
 from .registry import MODELS, DATASETS, LOSSES, OPTIMIZERS, SCHEDULES  # noqa: F401

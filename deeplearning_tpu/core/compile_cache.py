@@ -1,0 +1,58 @@
+"""Library-wide persistent XLA compile cache.
+
+One helper instead of the cache block previously copy-pasted in
+``bench.py`` and ``tools/bench_util.py``: every entry point (training
+CLIs, experiment loader, perf tools) calls ``enable_compile_cache()`` so
+a given step function is compiled at most once per machine, not once per
+process. On a wedge-prone remote-tunnel TPU the cold ViT-B/16 train-step
+compile is the longest single device-holding operation any tool runs;
+serializing the executable makes every later invocation near-instant.
+
+Env overrides:
+- ``DLTPU_COMPILE_CACHE=<dir>`` relocates the cache.
+- ``DLTPU_COMPILE_CACHE=0`` (or ``off``/``none``) disables it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# repo-root .jax_cache — the same location bench.py has always used, so
+# executables cached by the bench are hits for the CLIs and vice versa
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".jax_cache")
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (default: repo-root ``.jax_cache``, overridable via
+    ``DLTPU_COMPILE_CACHE``). Idempotent and never fatal — the cache is
+    an optimization, so any failure returns None instead of raising.
+    Returns the active cache dir, or None when disabled/unavailable."""
+    global _enabled_dir
+    env = os.environ.get("DLTPU_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "none", "false"):
+        return None
+    cache_dir = cache_dir or env or _DEFAULT_DIR
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even sub-second compiles: CPU smoke runs benefit too, and
+        # the min-entry-size floor would otherwise skip small executables
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 - never fail an entry point over caching
+        return None
+    _enabled_dir = cache_dir
+    return _enabled_dir
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory enabled by ``enable_compile_cache``, if any."""
+    return _enabled_dir

@@ -104,6 +104,10 @@ def get_exp(exp_file: Optional[str] = None, exp_name: Optional[str] = None
             ) -> BaseExp:
     """Load an Exp from a python file (must define ``Exp``) or from the
     EXPERIMENTS registry (yolox/exp/build.py get_exp surface)."""
+    # every experiment run pays a step-function compile; make it a
+    # once-per-machine cost instead of once-per-process
+    from .compile_cache import enable_compile_cache
+    enable_compile_cache()
     if exp_file:
         spec = importlib.util.spec_from_file_location(
             os.path.basename(exp_file).removesuffix(".py"), exp_file)

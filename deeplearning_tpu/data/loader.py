@@ -94,6 +94,12 @@ class DataLoader:
         self.num_workers = num_workers
         self.lookahead = max(lookahead, 1)
         self._pool = None
+        # starvation telemetry (parallel path only): time the consumer
+        # actually blocked waiting for decode futures of the LAST yielded
+        # batch, and the running total for the epoch. None on the serial
+        # path — consumers (Trainer data_time) fall back to wall-clock.
+        self.last_data_wait: Optional[float] = None
+        self.data_wait_total = 0.0
         n_proc = jax.process_count()
         if global_batch % n_proc:
             raise ValueError(f"global_batch {global_batch} not divisible by "
@@ -143,12 +149,20 @@ class DataLoader:
         fetch = lambda i: self.source[int(i)]
         pending: collections.deque = collections.deque()
         it = self._local_indices(epoch)
+        self.data_wait_total = 0.0
+        import time as _time
         try:
             for local in itertools.islice(it, self.lookahead):
                 pending.append([self._pool.submit(fetch, i) for i in local])
             while pending:
                 futs = pending.popleft()
+                # queue-empty wait: blocking on not-yet-done futures IS
+                # the starvation signal (done futures return instantly),
+                # so this isolates decode lag from batch assembly below
+                t0 = _time.perf_counter()
                 samples = [f.result() for f in futs]
+                self.last_data_wait = _time.perf_counter() - t0
+                self.data_wait_total += self.last_data_wait
                 batch = {k: np.stack([s[k] for s in samples])
                          for k in samples[0]}
                 yield self._finalize(batch)

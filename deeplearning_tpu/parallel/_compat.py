@@ -1,0 +1,44 @@
+"""JAX version compatibility for the parallel package.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and its replication check was renamed
+``check_rep`` -> ``check_vma``) after 0.4.x. The rest of this package
+writes against the modern surface — ``shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` — and this module backfills it on older
+releases by translating the kwarg onto the experimental entry point.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # modern surface (jax >= 0.5): top-level, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    SHARD_MAP_NATIVE = True
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma, **kw)
+
+except ImportError:  # 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    SHARD_MAP_NATIVE = False
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Static size of a bound mesh axis. ``jax.lax.axis_size`` is
+        post-0.4.x; ``psum(1, axis)`` constant-folds to a python int for
+        named axes on every release."""
+        return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["SHARD_MAP_NATIVE", "axis_size", "shard_map"]

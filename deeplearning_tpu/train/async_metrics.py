@@ -1,0 +1,81 @@
+"""Deferred device-metrics pipeline — the sync-free half of the Trainer.
+
+The hot loop's MFU ceiling is set by host↔device round-trips, not
+matmuls: every ``float(metrics["loss"])`` at a log point stalls the TPU
+dispatch queue until the in-flight step retires (arXiv:2004.13336 makes
+the same argument for weight-update overhead; the Gemma-on-TPU writeups
+attribute the last few MFU points to host-loop overlap).
+
+``DeferredMetrics`` removes the stall by decoupling *enqueue* from
+*materialize*: the Trainer pushes the device-scalar metrics dict of every
+step (a reference append — free), and only entries at least ``lag``
+pushes old are ever fetched. By then the corresponding step has long
+retired, so the D2H copy returns already-resolved buffers and costs
+microseconds instead of a pipeline flush. All ready entries are fetched
+in ONE ``jax.device_get`` call, so a poll is a single sync event no
+matter how many steps it covers.
+
+``fetch_count`` counts sync EVENTS (one per materializing poll/drain),
+``fetched_entries`` counts entries; both are the instrumentation surface
+the zero-sync smoke test asserts on.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+Entry = Tuple[Dict[str, Any], Dict[str, float]]   # (meta, host metrics)
+
+
+class DeferredMetrics:
+    """FIFO ring of (meta, device-metrics) entries with lagged fetch.
+
+    - ``push(tree, **meta)``: enqueue one step's device-scalar dict plus
+      host-side metadata (epoch, it, data_time, ...). Never syncs.
+    - ``poll()``: materialize (oldest-first) every entry that has at
+      least ``lag`` newer entries behind it; returns ``[(meta, host)]``.
+      One ``jax.device_get`` per call that returns anything.
+    - ``drain()``: materialize everything still buffered (epoch end /
+      shutdown barrier).
+    """
+
+    def __init__(self, lag: int = 1):
+        self.lag = max(int(lag), 0)
+        self._buf: collections.deque = collections.deque()
+        self.fetch_count = 0        # sync events (materializing calls)
+        self.fetched_entries = 0    # entries materialized in total
+
+    def push(self, tree: Dict[str, Any], **meta: Any) -> None:
+        self._buf.append((meta, tree))
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def poll(self) -> List[Entry]:
+        ready = []
+        while len(self._buf) > self.lag:
+            ready.append(self._buf.popleft())
+        return self._materialize(ready)
+
+    def drain(self) -> List[Entry]:
+        ready = list(self._buf)
+        self._buf.clear()
+        return self._materialize(ready)
+
+    def _materialize(self, entries) -> List[Entry]:
+        if not entries:
+            return []
+        self.fetch_count += 1
+        self.fetched_entries += len(entries)
+        # one bulk transfer for every ready tree: a poll is ONE sync
+        # event regardless of how many steps it covers
+        host_trees = jax.device_get([tree for _, tree in entries])
+        return [(meta, {k: float(v) for k, v in host.items()})
+                for (meta, _), host in zip(entries, host_trees)]

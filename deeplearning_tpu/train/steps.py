@@ -106,6 +106,11 @@ def make_train_step(
         metrics["grad_norm"] = jnp.sqrt(sum(
             jnp.sum(jnp.square(g.astype(jnp.float32)))
             for g in jax.tree.leaves(grads)))
+        # device-side divergence flag: the Trainer's deferred-metrics
+        # pipeline reads this from the stale snapshot instead of syncing
+        # the in-flight loss, so a non-finite step aborts training within
+        # the metrics lag with zero extra D2H round-trips
+        metrics["bad_step"] = (~jnp.isfinite(loss)).astype(jnp.int32)
         return state, metrics
 
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())

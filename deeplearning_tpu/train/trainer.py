@@ -27,6 +27,8 @@ from ..core.checkpoint import CheckpointManager
 from ..core.logging import (LoggerHub, MetricLogger,
                             TensorBoardWriter, create_logger,
                             is_main_process)
+from ..utils.profiling import RetraceGuard
+from .async_metrics import DeferredMetrics
 
 HOOKS = ("before_train", "after_train", "before_epoch", "after_epoch",
          "before_iter", "after_iter", "on_evaluate", "on_checkpoint")
@@ -68,9 +70,12 @@ class Trainer:
         abort_non_finite: bool = True,
         async_checkpoint: bool = False,
         log_backends=("tensorboard", "csv", "jsonl"),
+        metrics_lag: Optional[int] = None,
+        retrace_warn: bool = True,
     ):
         self.state = state
-        self.train_step = train_step
+        self.train_step = (RetraceGuard(train_step, name="train_step")
+                           if retrace_warn else train_step)
         self.train_loader = train_loader
         self.eval_step = eval_step
         self.eval_loader = eval_loader
@@ -92,9 +97,32 @@ class Trainer:
         self.meters = MetricLogger()
         self.rng = rng_mod.host_key(seed)
         self.epoch = 0
+        # sync-free hot loop (see README "Hot-loop sync policy"): every
+        # step's device-scalar metrics are enqueued here and only entries
+        # at least metrics_lag steps old are ever fetched — by then they
+        # are resolved, so the fetch never stalls the dispatch queue.
+        # Default lag = log_every: at each log point the previous log
+        # window is ready, so divergence aborts within 2*log_every steps.
+        self.metrics_lag = (metrics_lag if metrics_lag is not None
+                            else log_every)
+        self.deferred = DeferredMetrics(lag=self.metrics_lag)
+        self.eval_fetches = 0        # host materializations per evaluate()
+        self._host_step: Optional[int] = None  # host mirror of state.step
         self.ckpt = (CheckpointManager(f"{workdir}/ckpt",
                                        async_save=async_checkpoint)
                      if workdir else None)
+
+    @property
+    def host_step(self) -> int:
+        """Host-side step counter mirroring ``state.step`` without a
+        per-use D2H fetch; seeded once (from the restored state) and
+        incremented in lockstep with train_step calls."""
+        if self._host_step is None:
+            try:
+                self._host_step = int(getattr(self.state, "step", 0))
+            except TypeError:
+                self._host_step = 0
+        return self._host_step
 
     # ------------------------------------------------------------- train
     def train(self) -> Any:
@@ -104,6 +132,7 @@ class Trainer:
                 self.state = restored
                 steps_per_epoch = max(len(self.train_loader), 1)
                 self.epoch = int(step) // steps_per_epoch
+                self._host_step = int(step)
         self.callbacks.fire("before_train", self)
         try:
             for epoch in range(self.epoch, self.epochs):
@@ -135,45 +164,77 @@ class Trainer:
         return self.state
 
     def _train_one_epoch(self, epoch: int) -> None:
+        """Sync-free hot loop: the only host↔device round-trips are the
+        lagged fetches inside ``self.deferred`` (entries ≥ metrics_lag
+        steps old, already resolved) — never the in-flight step."""
         self.train_loader.set_epoch(epoch)
+        self.host_step          # seed the host mirror before the loop
+        n_iter = len(self.train_loader)
         t_data = time.time()
         for it, batch in enumerate(self.train_loader):
-            data_time = time.time() - t_data
+            wall_wait = time.time() - t_data
+            # prefer the loader's own queue-empty estimate (actual
+            # starvation) over wall-clock-between-iterations, which
+            # includes step dispatch time
+            loader_wait = getattr(self.train_loader, "last_data_wait",
+                                  None)
+            data_time = loader_wait if loader_wait is not None else \
+                wall_wait
             self.callbacks.fire("before_iter", self, batch=batch)
             self.state, metrics = self.train_step(self.state, batch,
                                                   self.rng)
             self.callbacks.fire("after_iter", self, metrics=metrics)
+            self._host_step = self.host_step + 1
+            self.deferred.push(metrics, epoch=epoch, it=it,
+                               step=self.host_step, n_iter=n_iter,
+                               data_time=data_time)
             if it % self.log_every == 0:
-                # scalar fetch both syncs and feeds the meters
-                host = {k: float(v) for k, v in metrics.items()}
-                # non-finite-loss abort (mnist/utils.py:53-55,
-                # fasterRcnn/train_eval_utils.py:44-47). Checked at the
-                # sync points: a per-iter device fetch would serialize the
-                # TPU pipeline, so divergence is caught within log_every
-                # steps rather than instantly.
-                if self.abort_non_finite and not np.isfinite(
+                self._consume(self.deferred.poll())
+            t_data = time.time()
+        # epoch-end barrier: one bulk fetch lands every remaining entry,
+        # so short epochs still log and a NaN in the tail still aborts
+        self._consume(self.deferred.drain())
+
+    def _consume(self, entries) -> None:
+        """Divergence-check every materialized entry, then log the
+        newest one (the stale snapshot that stands in for 'now')."""
+        if not entries:
+            return
+        if self.abort_non_finite:
+            for meta, host in entries:
+                # bad_step is the jitted isfinite(loss) flag; the loss
+                # check is the fallback for custom steps that don't
+                # provide it (non-finite params keep it latched anyway)
+                if host.get("bad_step", 0) > 0 or not np.isfinite(
                         host.get("loss", 0.0)):
                     self.logger.error(
-                        f"Loss is {host['loss']}, stopping training "
-                        f"(epoch {epoch} it {it})")
+                        f"Loss is {host.get('loss')}, stopping training "
+                        f"(epoch {meta['epoch']} it {meta['it']})")
                     raise FloatingPointError(
-                        f"non-finite loss {host['loss']} at epoch "
-                        f"{epoch} it {it}")
-                host["data_time"] = data_time
-                self.meters.update(**host)
-                step = int(self.state.step)
-                self.logger.info(
-                    f"epoch {epoch} it {it}/{len(self.train_loader)} "
-                    f"{self.meters}")
-                self.hub.scalars(
-                    {f"train/{k}": v for k, v in host.items()}, step)
-            t_data = time.time()
+                        f"non-finite loss {host.get('loss')} at epoch "
+                        f"{meta['epoch']} it {meta['it']}")
+        meta, host = entries[-1]
+        host = {k: v for k, v in host.items() if k != "bad_step"}
+        host["data_time"] = meta["data_time"]
+        self.meters.update(**host)
+        self.logger.info(
+            f"epoch {meta['epoch']} it {meta['it']}/{meta['n_iter']} "
+            f"{self.meters}")
+        self.hub.scalars({f"train/{k}": v for k, v in host.items()},
+                         meta["step"])
 
     # -------------------------------------------------------------- eval
     def evaluate(self) -> Dict[str, float]:
+        """Zero-sync eval: every batch's count dict stays on device while
+        the loop runs (dispatch only), then ONE ``jax.device_get`` lands
+        the whole list. Host-side accumulation order matches the old
+        per-batch-float path exactly, so totals are bitwise identical."""
+        per_batch = [self.eval_step(self.state, batch)
+                     for batch in self.eval_loader]
+        host_counts = jax.device_get(per_batch)   # the one materialization
+        self.eval_fetches += 1
         totals: Dict[str, float] = defaultdict(float)
-        for batch in self.eval_loader:
-            counts = self.eval_step(self.state, batch)
+        for counts in host_counts:
             for k, v in counts.items():
                 totals[k] += float(v)
         results = dict(totals)
@@ -188,7 +249,7 @@ class Trainer:
                          + "  ".join(f"{k}={v:.4f}"
                                      for k, v in results.items()))
         self.hub.scalars({f"eval/{k}": v for k, v in results.items()},
-                         int(self.state.step))
+                         self.host_step)
         value = results.get(self.best_metric)
         if value is not None and value > self.best_value:
             self.best_value = value
@@ -205,7 +266,14 @@ class Trainer:
 
     # -------------------------------------------------- throughput mode
     def throughput(self, n_iters: int = 30) -> float:
-        """images/sec over n averaged iters (swin main.py:281-300)."""
+        """images/sec over n averaged iters (swin main.py:281-300).
+
+        Two passes: a pipelined pass (single end sync) for the honest
+        mean images/sec, then a per-iter-synced pass over REAL loader
+        batches for step-time percentiles and the data-wait fraction —
+        the tail stats a mean hides. Percentiles land in
+        ``self.throughput_stats`` and perf_sweep output; the return value
+        stays the pipelined images/sec."""
         it = iter(self.train_loader)
         batch = next(it)
         bsz = jax.tree.leaves(batch)[0].shape[0]
@@ -217,6 +285,35 @@ class Trainer:
         float(m["loss"])
         dt = (time.perf_counter() - t0) / n_iters
         ips = bsz / dt
-        self.logger.info(f"throughput: {ips:.1f} images/s "
-                         f"({dt * 1e3:.1f} ms/iter, batch {bsz})")
+
+        step_times, data_times = [], []
+        for _ in range(n_iters):
+            t_d = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                it = iter(self.train_loader)
+                batch = next(it)
+            wait = getattr(self.train_loader, "last_data_wait", None)
+            data_times.append(wait if wait is not None
+                              else time.perf_counter() - t_d)
+            t_s = time.perf_counter()
+            self.state, m = self.train_step(self.state, batch, self.rng)
+            float(m["loss"])                  # per-iter sync: tail stats
+            step_times.append(time.perf_counter() - t_s)
+        p50, p90 = np.percentile(step_times, [50, 90])
+        busy = sum(step_times) + sum(data_times)
+        data_frac = sum(data_times) / busy if busy else 0.0
+        self.throughput_stats = {
+            "images_per_sec": ips,
+            "step_ms_mean": dt * 1e3,
+            "step_ms_p50": p50 * 1e3,
+            "step_ms_p90": p90 * 1e3,
+            "data_wait_frac": data_frac,
+            "batch": bsz,
+        }
+        self.logger.info(
+            f"throughput: {ips:.1f} images/s ({dt * 1e3:.1f} ms/iter "
+            f"pipelined, p50 {p50 * 1e3:.1f} ms, p90 {p90 * 1e3:.1f} ms, "
+            f"data-wait {data_frac:.1%}, batch {bsz})")
         return ips

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Callable, Dict, Optional
 
 import jax
@@ -51,9 +52,75 @@ class StepTimer:
         return sum(self.times) / max(len(self.times), 1)
 
 
+class RetraceGuard:
+    """Warn when a wrapped (jitted) step function sees a NEW abstract
+    argument signature after its first call — under ``jax.jit`` every new
+    shape/dtype/treedef signature forces a full XLA retrace, and a
+    retrace mid-epoch (shape churn from a sloppy loader, a dtype flip, a
+    non-dropped last batch) is the silent MFU killer: minutes of compile
+    amortized over zero extra steps.
+
+    Signatures are computed host-side from leaf shapes/dtypes (python
+    scalars hash by type, matching jit's weak-typed cache key), so the
+    guard costs a tree-flatten per call and never touches the device.
+    Deliberate shape buckets (multiscale training) warn once per new
+    bucket and then stay quiet.
+    """
+
+    def __init__(self, fn: Callable, name: str = "step",
+                 logger=None, max_warnings: int = 8):
+        self.fn = fn
+        self.name = name
+        self.logger = logger
+        self.max_warnings = max_warnings
+        self._sigs: set = set()
+        self.retraces = 0          # new signatures seen after the first
+
+    @property
+    def n_signatures(self) -> int:
+        return len(self._sigs)
+
+    @staticmethod
+    def _leaf_sig(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None and dtype is None:
+            return type(x).__name__
+        return (tuple(shape) if shape is not None else None, str(dtype))
+
+    def _signature(self, args, kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        return (str(treedef), tuple(self._leaf_sig(l) for l in leaves))
+
+    def __call__(self, *args, **kwargs):
+        sig = self._signature(args, kwargs)
+        if sig not in self._sigs:
+            self._sigs.add(sig)
+            if len(self._sigs) > 1:
+                self.retraces += 1
+                if self.retraces <= self.max_warnings:
+                    msg = (f"{self.name}: argument signature changed "
+                           f"({len(self._sigs)} distinct signatures seen) "
+                           "— each new shape/dtype forces an XLA retrace; "
+                           "pad or bucket inputs to fixed shapes")
+                    warnings.warn(msg, RuntimeWarning, stacklevel=2)
+                    if self.logger is not None:
+                        self.logger.warning(msg)
+        return self.fn(*args, **kwargs)
+
+
+def cost_analysis_dict(compiled) -> Dict[str, float]:
+    """``Compiled.cost_analysis()`` returns a dict in recent JAX and a
+    one-element list of dicts in older releases; normalize to a dict."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
 def compiled_flops(fn: Callable, *args) -> float:
-    cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-    return float(cost.get("flops", 0.0)) if cost else 0.0
+    cost = cost_analysis_dict(jax.jit(fn).lower(*args).compile())
+    return float(cost.get("flops", 0.0))
 
 
 def measure_mfu(step_fn: Callable, args: tuple, n_steps: int = 10,

@@ -2,6 +2,7 @@
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -9,15 +10,13 @@ import jax.numpy as jnp
 
 # Persistent XLA compile cache shared by every perf tool: a wedge-prone
 # tunnel means each completed compile should only ever be paid once per
-# round. (Mirror of the block in bench.py, which stays import-free of
-# tools/ — keep the two in sync.)
+# round. Canonical wiring lives in deeplearning_tpu.core.compile_cache
+# (same repo-root .jax_cache dir bench.py uses).
 try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deeplearning_tpu.core.compile_cache import enable_compile_cache
+    enable_compile_cache()
 except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
     pass
 

@@ -114,6 +114,8 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
                        donate_argnums=(0,)).lower(state, data,
                                                   rng).compile()
     cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):   # older JAX: list of dicts
+        cost = cost[0] if cost else {}
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
     # drive the ALREADY-compiled executable (re-calling step would pay a
@@ -126,12 +128,24 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
     mfu = step_flops / dt / peak_flops(jax.devices()[0]) * 100.0
+    # per-step-synced tail stats: the pipelined mean above hides stalls
+    # (a wedged iteration, host jitter); p50/p90 make regressions visible
+    per_step = []
+    for _ in range(min(n_steps, 10)):
+        t1 = time.perf_counter()
+        state, metrics = compiled(state, data, rng)
+        float(metrics["loss"])
+        per_step.append(time.perf_counter() - t1)
+    p50, p90 = np.percentile(per_step, [50, 90])
     print(f"{name:40s} batch={batch:4d} step={dt * 1e3:8.2f}ms "
-          f"img/s={batch / dt:8.1f} mfu={mfu:6.2f}%", flush=True)
+          f"img/s={batch / dt:8.1f} mfu={mfu:6.2f}% "
+          f"p50={p50 * 1e3:7.2f}ms p90={p90 * 1e3:7.2f}ms", flush=True)
     if results_path:
         from bench_util import append_result
         append_result(results_path, name, batch=batch, step_ms=dt * 1e3,
-                      img_per_s=batch / dt, mfu_pct=mfu, model=model_name)
+                      img_per_s=batch / dt, mfu_pct=mfu, model=model_name,
+                      step_ms_p50=round(p50 * 1e3, 2),
+                      step_ms_p90=round(p90 * 1e3, 2))
     del state, compiled, step
     return dt, mfu
 

@@ -111,6 +111,8 @@ def load_data(cfg: DataCfg, num_classes: int
 
 
 def main(argv=None) -> int:
+    from deeplearning_tpu.core.compile_cache import enable_compile_cache
+    enable_compile_cache()   # step compiles are once-per-machine, not per-run
     from deeplearning_tpu.core.config import config_cli
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.data import ArraySource, DataLoader

@@ -290,6 +290,8 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
 def main(argv=None) -> int:
     # --exp NAME: seed the config DEFAULTS from a registered DetectionExp
     # (exps/default/* analog). Precedence: defaults < exp < yaml < CLI.
+    from deeplearning_tpu.core.compile_cache import enable_compile_cache
+    enable_compile_cache()   # step compiles are once-per-machine, not per-run
     from deeplearning_tpu.core.config import config_cli, pop_flag
     argv = list(sys.argv[1:] if argv is None else argv)
     evolve_gens = pop_flag(argv, "--evolve")

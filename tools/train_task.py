@@ -572,6 +572,8 @@ RUNNERS = {
 
 
 def main(argv=None) -> int:
+    from deeplearning_tpu.core.compile_cache import enable_compile_cache
+    enable_compile_cache()   # step compiles are once-per-machine, not per-run
     from deeplearning_tpu.core.config import config_cli, pop_flag
 
     argv = list(sys.argv[1:] if argv is None else argv)
