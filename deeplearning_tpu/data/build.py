@@ -24,7 +24,8 @@ import jax
 import numpy as np
 
 from .datasets import folder_source, read_split_data, write_class_indices
-from .loader import DataLoader, prefetch_to_device
+from .device_prefetch import DevicePrefetcher
+from .loader import DataLoader, prefetch_to_device  # noqa: F401 - re-export
 from .transforms import eval_image_transform, get_train_transform
 
 
@@ -87,9 +88,16 @@ def build_classification_loaders(
 
 
 def device_iterator(loader: DataLoader, cfg: LoaderConfig, sharding=None):
-    """Epoch iterator with host→HBM prefetch overlapped with compute."""
-    return prefetch_to_device(iter(loader), size=cfg.prefetch,
-                              sharding=sharding)
+    """Loader wrapped in a threaded host→HBM prefetch stage.
+
+    Returns a :class:`DevicePrefetcher` (full loader protocol —
+    ``__len__``/``set_epoch``/``last_data_wait`` — so the Trainer can use
+    it directly), which takes over the loader's own device-put: each
+    batch is transferred exactly ONCE, on the prefetch worker thread.
+    The old shape of this function double-transferred (loader
+    ``_finalize`` device-put, then ``prefetch_to_device`` device-put
+    again)."""
+    return DevicePrefetcher(loader, depth=cfg.prefetch, sharding=sharding)
 
 
 def measure_throughput(loader: DataLoader, n_batches: int = 30,

@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 import jax
 import numpy as np
 
-from ..parallel.sharding import make_global_array
+from ..parallel.sharding import batch_spec, make_global_array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -94,6 +94,11 @@ class DataLoader:
         self.num_workers = num_workers
         self.lookahead = max(lookahead, 1)
         self._pool = None
+        # when False, batches are yielded as HOST numpy dicts even with a
+        # mesh — a wrapping DevicePrefetcher flips this to take over the
+        # host→HBM transfer on its worker thread (exactly one transfer
+        # per batch, off the consumer's critical path)
+        self.device_transfer = True
         # starvation telemetry (parallel path only): time the consumer
         # actually blocked waiting for decode futures of the LAST yielded
         # batch, and the running total for the epoch. None on the serial
@@ -125,10 +130,40 @@ class DataLoader:
     def _finalize(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         if self.transform:
             batch = self.transform(batch)
-        if self.mesh is not None:
+        if self.mesh is not None and self.device_transfer:
             batch = {k: make_global_array(np.asarray(v), self.mesh)
                      for k, v in batch.items()}
         return batch
+
+    def element_spec(self) -> Optional[Dict[str, jax.ShapeDtypeStruct]]:
+        """Abstract (shape, dtype, sharding) of one yielded batch — the
+        AOT-warmup surface: ``Trainer.precompile()`` lowers the jitted
+        step against these without materializing any data. Derived from
+        ONE source sample pushed through ``transform``, so it costs a
+        single decode, not a batch."""
+        try:
+            first = int(next(iter(self._local_indices(self.epoch)))[0])
+        except StopIteration:       # fewer samples than one global batch
+            return None
+        sample = self.source[np.asarray([first])]
+        if self.transform:
+            sample = self.transform(sample)
+        # with a mesh the consumer sees GLOBAL sharded arrays (assembled
+        # here or by a wrapping DevicePrefetcher); without, host-local
+        # numpy batches of host_batch rows
+        sharding = (NamedSharding(self.mesh, batch_spec())
+                    if self.mesh is not None else None)
+        lead = self.global_batch if self.mesh is not None else \
+            self.host_batch
+
+        def spec(v):
+            v = np.asarray(v)
+            shape = (lead, *v.shape[1:])
+            if sharding is not None:
+                return jax.ShapeDtypeStruct(shape, v.dtype,
+                                            sharding=sharding)
+            return jax.ShapeDtypeStruct(shape, v.dtype)
+        return {k: spec(v) for k, v in sample.items()}
 
     def _epoch_iter(self, epoch: int) -> Iterator[Dict[str, Any]]:
         if self.num_workers:
@@ -183,17 +218,36 @@ class DataLoader:
 
 
 def prefetch_to_device(iterator: Iterator, size: int = 2,
-                       sharding: Optional[NamedSharding] = None) -> Iterator:
+                       sharding: Optional[NamedSharding] = None,
+                       mesh: Optional[Mesh] = None) -> Iterator:
     """Overlap host→device copies with compute (DataPrefetcher analog;
-    flax.jax_utils.prefetch_to_device surface, mesh-sharding aware)."""
+    flax.jax_utils.prefetch_to_device surface, mesh-sharding aware).
+
+    Multi-host correct: with a ``mesh``, numpy leaves are assembled into
+    GLOBAL sharded arrays via ``make_global_array`` (a bare per-leaf
+    ``jax.device_put`` would build process-local arrays whose shapes
+    disagree with the jitted step's global batch spec). Leaves that are
+    already ``jax.Array`` pass through untouched, so an upstream loader
+    that device-puts internally is never double-transferred.
+
+    Prefer :class:`~deeplearning_tpu.data.device_prefetch.DevicePrefetcher`
+    for the Trainer path — it keeps the loader protocol (``set_epoch``,
+    ``__len__``) and runs the transfer on a real background thread; this
+    generator remains the minimal flax-style surface.
+    """
     queue: collections.deque = collections.deque()
 
-    def put(batch):
+    def place(x):
+        if isinstance(x, jax.Array):
+            return x                       # already on device — no copy
+        if mesh is not None:
+            return make_global_array(np.asarray(x), mesh)
         if sharding is not None:
-            batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
-        else:
-            batch = jax.tree.map(jax.device_put, batch)
-        queue.append(batch)
+            return jax.device_put(x, sharding)
+        return jax.device_put(x)
+
+    def put(batch):
+        queue.append(jax.tree.map(place, batch))
 
     it = iter(iterator)
     for b in itertools.islice(it, size):

@@ -15,19 +15,40 @@ microseconds instead of a pipeline flush. All ready entries are fetched
 in ONE ``jax.device_get`` call, so a poll is a single sync event no
 matter how many steps it covers.
 
+``window=W`` adds an ON-DEVICE windowed reduction (ROADMAP follow-up):
+instead of holding W per-step dicts and fetching W trees per log point,
+every push folds the step's metrics into a device-resident running sum
+(one tiny fused add dispatch — async, never syncs), and a completed
+window materializes as ONE dict of means. Host work per step and fetch
+volume per log point both stay O(1) however large ``log_every`` grows.
+Divergence detection survives the reduction: ``bad_step`` is summed, so
+"any bad step in the window" is just ``sum > 0``, and a NaN loss
+poisons the window mean.
+
 ``fetch_count`` counts sync EVENTS (one per materializing poll/drain),
-``fetched_entries`` counts entries; both are the instrumentation surface
-the zero-sync smoke test asserts on.
+``fetched_entries`` counts entries (windows, in windowed mode); both are
+the instrumentation surface the zero-sync smoke test asserts on.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 Entry = Tuple[Dict[str, Any], Dict[str, float]]   # (meta, host metrics)
+
+# metric keys reported as window SUMS, not means (latched flags where
+# "did it ever fire" is the question)
+_SUM_KEYS = ("bad_step",)
+
+
+@jax.jit
+def _accum(acc, tree):
+    """One fused device add per push — the O(1) windowed reduction."""
+    return jax.tree.map(jnp.add, acc, tree)
 
 
 class DeferredMetrics:
@@ -36,35 +57,70 @@ class DeferredMetrics:
     - ``push(tree, **meta)``: enqueue one step's device-scalar dict plus
       host-side metadata (epoch, it, data_time, ...). Never syncs.
     - ``poll()``: materialize (oldest-first) every entry that has at
-      least ``lag`` newer entries behind it; returns ``[(meta, host)]``.
+      least ``lag`` newer pushes behind it; returns ``[(meta, host)]``.
       One ``jax.device_get`` per call that returns anything.
     - ``drain()``: materialize everything still buffered (epoch end /
       shutdown barrier).
+    - ``window=W``: device-side reduction — pushes fold into a running
+      sum, completed windows surface as single mean dicts (meta of the
+      window's LAST step). ``lag`` then counts pushes since the window
+      closed, so a fetch still never touches an in-flight step.
     """
 
-    def __init__(self, lag: int = 1):
+    def __init__(self, lag: int = 1, window: Optional[int] = None):
         self.lag = max(int(lag), 0)
+        self.window = max(int(window), 1) if window else None
         self._buf: collections.deque = collections.deque()
         self.fetch_count = 0        # sync events (materializing calls)
         self.fetched_entries = 0    # entries materialized in total
+        # open-window accumulation state (window mode only)
+        self._push_idx = 0
+        self._open_acc = None
+        self._open_n = 0
+        self._open_meta: Dict[str, Any] = {}
 
     def push(self, tree: Dict[str, Any], **meta: Any) -> None:
-        self._buf.append((meta, tree))
+        self._push_idx += 1
+        if self.window is None:
+            self._buf.append((meta, tree))
+            return
+        self._open_acc = (tree if self._open_acc is None
+                          else _accum(self._open_acc, tree))
+        self._open_n += 1
+        self._open_meta = meta
+        if self._open_n >= self.window:
+            self._close_window()
+
+    def _close_window(self) -> None:
+        if not self._open_n:
+            return
+        self._buf.append((self._open_meta, self._open_acc, self._open_n,
+                          self._push_idx))
+        self._open_acc, self._open_n, self._open_meta = None, 0, {}
 
     @property
     def pending(self) -> int:
-        return len(self._buf)
+        return len(self._buf) + (1 if self._open_n else 0)
 
     def __len__(self) -> int:
-        return len(self._buf)
+        return self.pending
 
     def poll(self) -> List[Entry]:
         ready = []
-        while len(self._buf) > self.lag:
-            ready.append(self._buf.popleft())
+        if self.window is None:
+            while len(self._buf) > self.lag:
+                ready.append(self._buf.popleft())
+        else:
+            # a closed window is ready once >= lag pushes happened after
+            # it closed — its newest contribution resolved long ago
+            while self._buf and \
+                    self._push_idx - self._buf[0][3] >= self.lag:
+                ready.append(self._buf.popleft())
         return self._materialize(ready)
 
     def drain(self) -> List[Entry]:
+        if self.window is not None:
+            self._close_window()
         ready = list(self._buf)
         self._buf.clear()
         return self._materialize(ready)
@@ -76,6 +132,14 @@ class DeferredMetrics:
         self.fetched_entries += len(entries)
         # one bulk transfer for every ready tree: a poll is ONE sync
         # event regardless of how many steps it covers
-        host_trees = jax.device_get([tree for _, tree in entries])
-        return [(meta, {k: float(v) for k, v in host.items()})
-                for (meta, _), host in zip(entries, host_trees)]
+        if self.window is None:
+            host_trees = jax.device_get([tree for _, tree in entries])
+            return [(meta, {k: float(v) for k, v in host.items()})
+                    for (meta, _), host in zip(entries, host_trees)]
+        host_trees = jax.device_get([acc for _, acc, _, _ in entries])
+        out: List[Entry] = []
+        for (meta, _, n, _), host in zip(entries, host_trees):
+            out.append((meta, {
+                k: float(v) if k in _SUM_KEYS else float(v) / n
+                for k, v in host.items()}))
+        return out

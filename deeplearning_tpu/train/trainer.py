@@ -27,6 +27,7 @@ from ..core.checkpoint import CheckpointManager
 from ..core.logging import (LoggerHub, MetricLogger,
                             TensorBoardWriter, create_logger,
                             is_main_process)
+from ..data.device_prefetch import DevicePrefetcher
 from ..utils.profiling import RetraceGuard
 from .async_metrics import DeferredMetrics
 
@@ -71,12 +72,20 @@ class Trainer:
         async_checkpoint: bool = False,
         log_backends=("tensorboard", "csv", "jsonl"),
         metrics_lag: Optional[int] = None,
+        metrics_window: Optional[int] = None,
         retrace_warn: bool = True,
+        prefetch="auto",
     ):
         self.state = state
         self.train_step = (RetraceGuard(train_step, name="train_step")
                            if retrace_warn else train_step)
-        self.train_loader = train_loader
+        # overlapped device feed (see README "Input feed & donation
+        # policy"): with a mesh-bearing loader the serial host→HBM
+        # transfer is the hot loop's last blocking stage, so auto-wrap it
+        # in a DevicePrefetcher. prefetch="auto" wraps only mesh loaders;
+        # an int wraps any epoch-protocol loader at that depth; 0/None
+        # disables wrapping.
+        self.train_loader = self._wrap_prefetch(train_loader, prefetch)
         self.eval_step = eval_step
         self.eval_loader = eval_loader
         self.epochs = epochs
@@ -105,7 +114,15 @@ class Trainer:
         # window is ready, so divergence aborts within 2*log_every steps.
         self.metrics_lag = (metrics_lag if metrics_lag is not None
                             else log_every)
-        self.deferred = DeferredMetrics(lag=self.metrics_lag)
+        # windowed on-device reduction: at log_every ≫ 100 holding (and
+        # fetching) one scalar dict PER STEP is the remaining O(log_every)
+        # host cost, so auto-fold the window into a device-resident
+        # running mean (one fused add per push). None = auto threshold;
+        # 0 disables; an int forces that window.
+        self.metrics_window = (metrics_window if metrics_window is not None
+                               else (log_every if log_every > 100 else 0))
+        self.deferred = DeferredMetrics(lag=self.metrics_lag,
+                                        window=self.metrics_window or None)
         self.eval_fetches = 0        # host materializations per evaluate()
         self._host_step: Optional[int] = None  # host mirror of state.step
         self.ckpt = (CheckpointManager(f"{workdir}/ckpt",
@@ -123,6 +140,58 @@ class Trainer:
             except TypeError:
                 self._host_step = 0
         return self._host_step
+
+    # ----------------------------------------------------- device feed
+    @staticmethod
+    def _wrap_prefetch(loader, prefetch):
+        if loader is None or not prefetch:
+            return loader
+        if isinstance(loader, DevicePrefetcher):
+            return loader                     # caller already wrapped it
+        if prefetch == "auto":
+            # only wrap loaders that own a mesh (their batches need the
+            # make_global_array assembly the prefetcher hides) and speak
+            # the epoch protocol the wrapper must preserve
+            if getattr(loader, "mesh", None) is None or \
+                    not hasattr(loader, "set_epoch"):
+                return loader
+            depth = 2
+        else:
+            depth = int(prefetch)
+        return DevicePrefetcher(loader, depth=depth)
+
+    def precompile(self):
+        """AOT step warmup: compile the train step against the loader's
+        ABSTRACT batch spec (``element_spec``) before any data exists —
+        ``jit(...).lower(...).compile()`` lands the executable in jit's
+        cache and the persistent compile cache (``core/compile_cache``),
+        so the first real step dispatches instead of serializing a
+        multi-minute XLA compile after the first batch arrives.
+
+        When the train loader is a DevicePrefetcher, its worker thread
+        is started FIRST, so first-batch decode + H2D transfer fill the
+        queue while XLA compiles on this thread. Returns compile seconds,
+        or None when the loader/step has no AOT surface."""
+        from ..core.compile_cache import enable_compile_cache
+        enable_compile_cache()
+        if hasattr(self.train_loader, "start"):
+            self.train_loader.start()         # overlap feed with compile
+        spec_fn = getattr(self.train_loader, "element_spec", None)
+        batch_spec = spec_fn() if spec_fn is not None else None
+        if batch_spec is None:
+            return None
+        # unwrap the RetraceGuard to reach the jitted function's .lower
+        fn = getattr(self.train_step, "fn", self.train_step)
+        if not hasattr(fn, "lower"):
+            return None
+        t0 = time.perf_counter()
+        self._aot_step = fn.lower(self.state, batch_spec,
+                                  self.rng).compile()
+        dt = time.perf_counter() - t0
+        self.precompile_seconds = dt
+        self.logger.info(f"precompile: train step AOT-compiled in "
+                         f"{dt:.2f}s (overlapped with feed warmup)")
+        return dt
 
     # ------------------------------------------------------------- train
     def train(self) -> Any:
@@ -194,6 +263,17 @@ class Trainer:
         # epoch-end barrier: one bulk fetch lands every remaining entry,
         # so short epochs still log and a NaN in the tail still aborts
         self._consume(self.deferred.drain())
+        # feed telemetry (DevicePrefetcher): queue occupancy + H2D wait
+        # land next to the train scalars so an input-bound epoch is
+        # visible without a profiler
+        feed_stats = getattr(self.train_loader, "stats", None)
+        if feed_stats is not None:
+            self.hub.scalars({f"feed/{k}": v
+                              for k, v in feed_stats().items()},
+                             self.host_step)
+            reset = getattr(self.train_loader, "reset_stats", None)
+            if reset is not None:
+                reset()
 
     def _consume(self, entries) -> None:
         """Divergence-check every materialized entry, then log the
@@ -265,55 +345,85 @@ class Trainer:
         self.callbacks.fire("on_checkpoint", self, step=step)
 
     # -------------------------------------------------- throughput mode
-    def throughput(self, n_iters: int = 30) -> float:
+    def throughput(self, n_iters: int = 30, lag: int = 3) -> float:
         """images/sec over n averaged iters (swin main.py:281-300).
 
-        Two passes: a pipelined pass (single end sync) for the honest
-        mean images/sec, then a per-iter-synced pass over REAL loader
-        batches for step-time percentiles and the data-wait fraction —
-        the tail stats a mean hides. Percentiles land in
-        ``self.throughput_stats`` and perf_sweep output; the return value
-        stays the pipelined images/sec."""
-        it = iter(self.train_loader)
+        ONE pipelined pass over real loader batches. Per-step tail stats
+        come from a lagged metrics ring instead of a per-iter
+        ``float(m["loss"])`` sync: after dispatching step i the loop
+        fetches step i-``lag``'s metrics — a buffer that is the only
+        UNRETIRED work older than the ``lag`` steps still in flight, so
+        the fetch completes the moment that step does without draining
+        the dispatch queue. Timestamp deltas between those lagged
+        completions ARE the pipelined per-step times (p50/p90), the same
+        quantity the old serializing pass approximated while flushing
+        the pipe every iteration.
+
+        Donation-safe by construction: every dispatched batch is a fresh
+        one from the loader (never reused), so ``donate_batch=True``
+        steps measure identically. When the loader is a
+        ``DevicePrefetcher``, its queue-occupancy / H2D-wait counters are
+        folded into ``throughput_stats``."""
+        import collections as _collections
+        if n_iters < 2:
+            raise ValueError("throughput needs n_iters >= 2")
+        lag = max(1, min(int(lag), n_iters - 1))
+        loader = self.train_loader
+        reset = getattr(loader, "reset_stats", None)
+        if reset is not None:
+            reset()
+
+        def cycle():
+            while True:
+                got = False
+                for b in iter(loader):
+                    got = True
+                    yield b
+                if not got:
+                    raise ValueError("loader yielded zero batches")
+        it = cycle()
         batch = next(it)
         bsz = jax.tree.leaves(batch)[0].shape[0]
+        # warmup: compile + land the executable, then drain (clean start)
         self.state, m = self.train_step(self.state, batch, self.rng)
-        float(m["loss"])                      # sync
+        float(m["loss"])                      # the one draining sync
+        ring: "_collections.deque" = _collections.deque()
+        lag_marks, data_times = [], []
         t0 = time.perf_counter()
         for _ in range(n_iters):
-            self.state, m = self.train_step(self.state, batch, self.rng)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / n_iters
-        ips = bsz / dt
-
-        step_times, data_times = [], []
-        for _ in range(n_iters):
             t_d = time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                it = iter(self.train_loader)
-                batch = next(it)
-            wait = getattr(self.train_loader, "last_data_wait", None)
+            batch = next(it)
+            wait = getattr(loader, "last_data_wait", None)
             data_times.append(wait if wait is not None
                               else time.perf_counter() - t_d)
-            t_s = time.perf_counter()
             self.state, m = self.train_step(self.state, batch, self.rng)
-            float(m["loss"])                  # per-iter sync: tail stats
-            step_times.append(time.perf_counter() - t_s)
+            ring.append(m)
+            if len(ring) > lag:
+                float(ring.popleft()["loss"])  # lagged, non-draining
+                lag_marks.append(time.perf_counter())
+        while ring:                            # end-of-run drain
+            float(ring.popleft()["loss"])
+            lag_marks.append(time.perf_counter())
+        total = time.perf_counter() - t0
+        ips = bsz * n_iters / total
+        step_times = np.diff(lag_marks) if len(lag_marks) > 1 else \
+            np.asarray([total / n_iters])
         p50, p90 = np.percentile(step_times, [50, 90])
-        busy = sum(step_times) + sum(data_times)
-        data_frac = sum(data_times) / busy if busy else 0.0
+        data_frac = sum(data_times) / total if total else 0.0
         self.throughput_stats = {
             "images_per_sec": ips,
-            "step_ms_mean": dt * 1e3,
+            "step_ms_mean": total / n_iters * 1e3,
             "step_ms_p50": p50 * 1e3,
             "step_ms_p90": p90 * 1e3,
             "data_wait_frac": data_frac,
             "batch": bsz,
         }
+        feed_stats = getattr(loader, "stats", None)
+        if feed_stats is not None:
+            self.throughput_stats.update(feed_stats())
         self.logger.info(
-            f"throughput: {ips:.1f} images/s ({dt * 1e3:.1f} ms/iter "
-            f"pipelined, p50 {p50 * 1e3:.1f} ms, p90 {p90 * 1e3:.1f} ms, "
-            f"data-wait {data_frac:.1%}, batch {bsz})")
+            f"throughput: {ips:.1f} images/s "
+            f"({total / n_iters * 1e3:.1f} ms/iter pipelined, "
+            f"p50 {p50 * 1e3:.1f} ms, p90 {p90 * 1e3:.1f} ms, "
+            f"data-wait {data_frac:.1%}, batch {bsz}, lag {lag})")
         return ips

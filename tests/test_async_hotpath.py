@@ -53,6 +53,84 @@ class TestDeferredMetrics:
         assert meta["epoch"] == 3 and meta["data_time"] == 0.5
 
 
+class TestWindowedMetrics:
+    def test_window_means_and_meta(self):
+        ring = DeferredMetrics(lag=0, window=4)
+        for i in range(8):
+            ring.push({"loss": jnp.asarray(float(i))}, it=i)
+        ready = ring.poll()
+        assert [h["loss"] for _, h in ready] == [1.5, 5.5]   # window means
+        assert [m["it"] for m, _ in ready] == [3, 7]   # last step's meta
+        assert ring.fetch_count == 1 and ring.fetched_entries == 2
+
+    def test_host_state_is_o1_per_step(self):
+        """100 pushes at window=10 hold 10 closed windows + one device
+        accumulator — never 100 per-step dicts."""
+        ring = DeferredMetrics(lag=0, window=10)
+        for i in range(105):
+            ring.push({"loss": jnp.asarray(1.0)}, it=i)
+        assert len(ring._buf) == 10
+        assert ring._open_n == 5
+        assert ring.pending == 11
+
+    def test_bad_step_is_summed_not_averaged(self):
+        ring = DeferredMetrics(lag=0, window=4)
+        for i in range(4):
+            ring.push({"loss": jnp.asarray(1.0),
+                       "bad_step": jnp.int32(1 if i == 2 else 0)})
+        (_, host), = ring.poll()
+        assert host["bad_step"] == 1.0        # any bad step survives
+        assert host["loss"] == 1.0
+
+    def test_nan_poisons_window_mean(self):
+        ring = DeferredMetrics(lag=0, window=3)
+        for v in (1.0, float("nan"), 2.0):
+            ring.push({"loss": jnp.asarray(v)})
+        (_, host), = ring.poll()
+        assert not np.isfinite(host["loss"])
+
+    def test_lag_counts_pushes_since_close(self):
+        ring = DeferredMetrics(lag=3, window=2)
+        for i in range(4):                    # windows close at push 2, 4
+            ring.push({"x": jnp.asarray(float(i))})
+        assert ring.poll() == []              # newest close only 0 old
+        for i in range(2):                    # pushes 5, 6
+            ring.push({"x": jnp.asarray(float(i))})
+        ready = ring.poll()                   # first window now 4 old
+        assert len(ready) == 1 and ready[0][1]["x"] == 0.5
+        assert ring.pending == 2              # windows closed at 4 and 6
+
+    def test_drain_closes_partial_window(self):
+        ring = DeferredMetrics(lag=5, window=4)
+        for i in range(3):
+            ring.push({"x": jnp.asarray(float(i))})
+        entries = ring.drain()
+        assert len(entries) == 1 and entries[0][1]["x"] == 1.0
+        assert ring.pending == 0
+
+    def test_trainer_auto_window_and_divergence(self):
+        """log_every > 100 turns the windowed reduction on; the NaN
+        abort still fires through the window-mean path."""
+        trainer = make_trainer(epochs=1, log_every=150, n=5 * 16, batch=16)
+        assert trainer.metrics_window == 150
+        trainer.train()
+        # 5 steps fold into ONE partial window drained at epoch end
+        assert trainer.deferred.fetched_entries == 1
+        assert trainer.deferred.fetch_count <= 1
+
+        base = make_train_step(make_loss_fn(), donate=False)
+
+        def nan_step(state, batch, rng):
+            state, metrics = base(state, batch, rng)
+            bad = jnp.float32(float("nan"))
+            return state, {**metrics, "loss": bad, "bad_step": jnp.int32(1)}
+
+        trainer = make_trainer(nan_step, epochs=1, log_every=150,
+                               n=5 * 16, batch=16)
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            trainer.train()
+
+
 def synthetic_cls(n=96, seed=0):
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, 4, n).astype(np.int32)
@@ -63,7 +141,7 @@ def synthetic_cls(n=96, seed=0):
 
 
 def make_trainer(train_step=None, *, epochs=1, log_every=100, n=96,
-                 metrics_lag=None, batch=32):
+                 metrics_lag=None, batch=32, **trainer_kw):
     images, labels = synthetic_cls(n)
     model = MODELS.build("mnist_fcn", num_classes=4, dtype=jnp.float32)
     params = model.init(jax.random.key(0),
@@ -82,7 +160,8 @@ def make_trainer(train_step=None, *, epochs=1, log_every=100, n=96,
         train_loader=loader,
         eval_step=make_eval_step(make_metric_fn(ks=(1,))),
         eval_loader=eval_loader,
-        epochs=epochs, log_every=log_every, metrics_lag=metrics_lag)
+        epochs=epochs, log_every=log_every, metrics_lag=metrics_lag,
+        **trainer_kw)
 
 
 class TestZeroSyncHotLoop:
@@ -96,6 +175,22 @@ class TestZeroSyncHotLoop:
         assert trainer.deferred.fetched_entries == 5   # every step checked
         assert trainer.deferred.fetch_count <= 1
         assert trainer.deferred.pending == 0
+
+    def test_wrapped_loader_keeps_sync_bound(self):
+        """Same ≤1-sync bound with the hot loop fed through a
+        DevicePrefetcher: the overlapped feed must not reintroduce any
+        D2H fetch between log points."""
+        from deeplearning_tpu.data import DevicePrefetcher
+        trainer = make_trainer(epochs=1, log_every=100, n=5 * 16, batch=16,
+                               prefetch=2)
+        assert isinstance(trainer.train_loader, DevicePrefetcher)
+        assert len(trainer.train_loader) == 5
+        trainer.train()
+        assert trainer.deferred.fetched_entries == 5
+        assert trainer.deferred.fetch_count <= 1
+        assert trainer.deferred.pending == 0
+        # feed telemetry flowed through the epoch-end reset
+        assert trainer.train_loader.batches_fed == 0   # reset after epoch
 
     def test_device_side_guard_aborts_within_lag_window(self):
         """Injected NaN loss at step N aborts within metrics_lag +

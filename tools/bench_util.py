@@ -47,6 +47,21 @@ def append_result(path, variant, *, batch, step_ms, img_per_s, mfu_pct,
     return rec
 
 
+def feed_stats(source):
+    """Device-feed telemetry columns for bench rows.
+
+    Accepts a ``DevicePrefetcher`` (calls its ``stats()``) or an
+    already-built stats dict (e.g. ``Trainer.throughput_stats``) and
+    returns the input-feed subset every perf row should carry —
+    ``h2d_wait_frac`` + ``prefetch_occupancy`` are what let the next
+    on-chip run attribute an MFU delta to feed overlap vs step compute."""
+    stats = source.stats() if callable(getattr(source, "stats", None)) \
+        else dict(source)
+    keys = ("h2d_wait_frac", "prefetch_occupancy", "prefetch_depth",
+            "data_wait_frac")
+    return {k: round(float(stats[k]), 4) for k in keys if k in stats}
+
+
 def sync(x):
     # D2H scalar fetch — block_until_ready is unreliable on this
     # remote-tunnel backend; a host fetch always syncs. Accepts any

@@ -150,10 +150,85 @@ def time_variant(name, batch, attn_fn=None, remat=False, n_steps=20,
     return dt, mfu
 
 
+def time_feed_variant(name, batch, n_steps=20, depth=2,
+                      model_name="vit_base_patch16_224", image_size=224,
+                      results_path=None):
+    """End-to-end FEED benchmark: the jitted step driven through the
+    Trainer's pipelined throughput pass over REAL loader batches, wrapped
+    (depth>0) or not (depth=0) in a DevicePrefetcher. Unlike
+    ``time_variant`` (one resident device batch, pure step time), every
+    iteration here pays decode + host→HBM transfer — the row's
+    ``h2d_wait_frac`` / ``prefetch_occupancy`` columns show how much of
+    it the prefetch pipeline hides, so an on-chip A/B of
+    feed_prefetch vs feed_serial attributes the MFU delta directly."""
+    import numpy as np
+
+    from bench_util import feed_stats
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.data import ArraySource, DataLoader
+    from deeplearning_tpu.train import TrainState, make_train_step
+    from deeplearning_tpu.train.classification import make_loss_fn
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+    from deeplearning_tpu.train.trainer import Trainer
+    from deeplearning_tpu.utils.profiling import cost_analysis_dict
+
+    model = MODELS.build(model_name, num_classes=1000)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, image_size, image_size, 3)),
+                           train=False)
+    params = variables["params"]
+    sched = build_schedule("warmup_cosine", base_lr=1e-3,
+                           total_steps=10_000, warmup_steps=100)
+    tx = build_optimizer("adamw", sched, weight_decay=0.05, params=params)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx,
+                              batch_stats=variables.get("batch_stats"))
+    rng = np.random.default_rng(0)
+    n_data = batch * 4          # enough distinct batches to cycle
+    images = rng.normal(size=(n_data, image_size, image_size, 3)
+                        ).astype(np.float32)
+    labels = rng.integers(0, 1000, n_data).astype(np.int32)
+    loader = DataLoader(ArraySource(image=images, label=labels),
+                        global_batch=batch, shuffle=False)
+    step = make_train_step(
+        make_loss_fn(label_smoothing=0.1,
+                     has_batch_stats=variables.get("batch_stats")
+                     is not None),
+        donate=True, donate_batch=True)
+    trainer = Trainer(state=state, train_step=step, train_loader=loader,
+                      retrace_warn=False,
+                      prefetch=depth if depth else 0)
+    aot = trainer.precompile()   # AOT warmup overlapped with feed start
+    flops = 0.0
+    if getattr(trainer, "_aot_step", None) is not None:
+        flops = float(cost_analysis_dict(trainer._aot_step
+                                         ).get("flops", 0.0))
+    ips = trainer.throughput(n_iters=n_steps)
+    stats = trainer.throughput_stats
+    dt = stats["step_ms_mean"] / 1e3
+    mfu = flops / dt / peak_flops(jax.devices()[0]) * 100.0 if flops \
+        else 0.0
+    feed = feed_stats(stats)
+    print(f"{name:40s} batch={batch:4d} step={dt * 1e3:8.2f}ms "
+          f"img/s={ips:8.1f} mfu={mfu:6.2f}% "
+          f"h2d_frac={feed.get('h2d_wait_frac', 0.0):6.3f} "
+          f"occ={feed.get('prefetch_occupancy', 0.0):4.1f} "
+          f"aot={0.0 if aot is None else aot:6.2f}s", flush=True)
+    if results_path:
+        from bench_util import append_result
+        append_result(results_path, name, batch=batch, step_ms=dt * 1e3,
+                      img_per_s=ips, mfu_pct=mfu, model=model_name,
+                      step_ms_p50=round(stats["step_ms_p50"], 2),
+                      step_ms_p90=round(stats["step_ms_p90"], 2),
+                      **feed)
+    return dt, mfu
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
-                    choices=["batch", "attn", "all", "r5", "decomp"])
+                    choices=["batch", "attn", "all", "r5", "decomp",
+                             "feed"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -179,6 +254,15 @@ def main():
                      attn_fn=bf16_softmax_attention, results_path=results)
         with patch_embed_as_conv():
             time_variant("patch_conv_b128", 128, results_path=results)
+    if args.set == "feed":
+        # feed-side A/B for the MFU claim: serial blocking H2D vs the
+        # threaded prefetch pipeline, same step, real per-iter batches
+        time_feed_variant("feed_serial_b128", 128, depth=0,
+                          results_path=results)
+        time_feed_variant("feed_prefetch_b128", 128, depth=2,
+                          results_path=results)
+        time_feed_variant("feed_prefetch_deep_b128", 128, depth=4,
+                          results_path=results)
     if args.set == "decomp":
         # empirical step-time decomposition (ceiling analysis): replace a
         # subsystem with identity and read the step-time delta vs the

@@ -53,6 +53,7 @@ class DataCfg:
     val_rate: float = 0.2            # folder-mode train/val split
     num_workers: int = 8             # folder-mode decode threads
     augment: str = "imagenet"        # imagenet | light | none
+    prefetch: int = 2                # device-feed queue depth (0 = off)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +83,8 @@ class TrainCfg:
     pipeline_stages: int = 1         # >1: GPipe pipeline over 'model' axis
                                      # (ViT family; blocks split S-ways)
     microbatches: int = 0            # pipeline microbatches (0 = stages)
+    donate_batch: bool = True        # recycle input HBM buffers per step
+    precompile: bool = True          # AOT step compile overlapped w/ feed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -291,7 +294,8 @@ def main(argv=None) -> int:
     else:
         base_step = make_train_step(
             make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh,
-            accum_steps=cfg.train.accum_steps)
+            accum_steps=cfg.train.accum_steps,
+            donate_batch=cfg.train.donate_batch)
     if cfg.train.mixup:
         from deeplearning_tpu.core import rng as rng_mod
         from deeplearning_tpu.data.mixup import mixup_cutmix
@@ -304,7 +308,9 @@ def main(argv=None) -> int:
             batch = mixup_cutmix(batch, aug_key, cfg.model.num_classes,
                                  smoothing=cfg.train.label_smoothing)
             return base_step(s, batch, rng)
-        train_step = jax.jit(train_step, donate_argnums=(0,))
+        train_step = jax.jit(
+            train_step,
+            donate_argnums=(0, 1) if cfg.train.donate_batch else (0,))
     else:
         train_step = base_step
     trainer = Trainer(
@@ -318,7 +324,16 @@ def main(argv=None) -> int:
         seed=cfg.train.seed,
         workdir=cfg.train.workdir,
         async_checkpoint=cfg.train.async_checkpoint,
-        log_every=max(steps_per_epoch // 2, 1))
+        log_every=max(steps_per_epoch // 2, 1),
+        prefetch=cfg.data.prefetch)
+    if cfg.train.precompile:
+        try:
+            # AOT step compile runs while the prefetcher's worker thread
+            # decodes + transfers the first batches — neither serializes
+            # behind the other
+            trainer.precompile()
+        except Exception as e:  # noqa: BLE001 - warmup is best-effort
+            print(f"precompile skipped: {e}")
     trainer.train()
     results = trainer.evaluate()
     print({k: round(v, 4) for k, v in results.items()})

@@ -67,6 +67,7 @@ class DetDataCfg:
     shear: float = 0.0
     val_rate: float = 0.1            # coco-mode eval split
     num_workers: int = 8             # coco-mode decode threads
+    prefetch: int = 2                # device-feed queue depth (0 = off)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -465,10 +466,17 @@ def run(cfg) -> dict:
     key = jax.random.key(cfg.train.seed)
 
     def make_loader_fn(src, seed):
+        from deeplearning_tpu.data.device_prefetch import DevicePrefetcher
         from deeplearning_tpu.data.loader import DataLoader
         loader = DataLoader(src, cfg.data.batch, shuffle=True, seed=seed,
                             infinite=True,
                             num_workers=cfg.data.num_workers)
+        if cfg.data.prefetch:
+            # decode + H2D run on the prefetch worker thread, overlapped
+            # with the previous step's compute; the old shape blocked on
+            # a per-leaf jnp.asarray transfer inside the step loop
+            it = iter(DevicePrefetcher(loader, depth=cfg.data.prefetch))
+            return lambda: next(it)
         it = iter(loader)
         return lambda: {k: jnp.asarray(v) for k, v in next(it).items()}
 
