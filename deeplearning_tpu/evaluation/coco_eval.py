@@ -89,6 +89,46 @@ class CocoEvaluator:
             "labels": np.asarray(det_labels, np.int64).reshape(-1),
         }
 
+    def add_batch(self, image_ids, det: Dict, gt: Dict,
+                  image_valid=None) -> None:
+        """Consume one eval step's *batched* padded outputs — the shape
+        the jitted batched postprocess emits — with exactly one host
+        conversion per array (each ``np.asarray`` below is the single
+        D2H materialization for the whole batch; no per-image device
+        slicing, no per-image retraces).
+
+        det: {'boxes' (B,D,4), 'scores' (B,D), 'labels' (B,D),
+        'valid' (B,D)}; gt: {'boxes' (B,G,4), 'labels' (B,G),
+        'valid' (B,G), optional 'crowd' (B,G)}; ``image_valid`` (B,)
+        masks wrap-around padding images. Padded detection slots are
+        dropped by the valid mask AND by label < 0 (the
+        ``gather_nms_outputs`` fill), so a padded slot can never alias a
+        real class-0 / score-0 detection."""
+        det_boxes = np.asarray(det["boxes"], np.float64)
+        det_scores = np.asarray(det["scores"], np.float64)
+        det_labels = np.asarray(det["labels"], np.int64)
+        det_valid = np.asarray(det["valid"], bool) & (det_labels >= 0)
+        gt_boxes = np.asarray(gt["boxes"], np.float64)
+        gt_labels = np.asarray(gt["labels"], np.int64)
+        gt_valid = np.asarray(gt["valid"], bool)
+        gt_crowd = np.asarray(gt["crowd"], bool) if "crowd" in gt else None
+        image_ids = np.asarray(image_ids, np.int64)
+        if image_valid is not None:
+            image_valid = np.asarray(image_valid, bool)
+        for j, img_id in enumerate(image_ids):
+            if image_valid is not None and not image_valid[j]:
+                continue
+            dv = det_valid[j]
+            gv = gt_valid[j]
+            self.add_image(
+                int(img_id),
+                gt_boxes=gt_boxes[j][gv],
+                gt_labels=gt_labels[j][gv],
+                det_boxes=det_boxes[j][dv],
+                det_scores=det_scores[j][dv],
+                det_labels=det_labels[j][dv],
+                gt_crowd=gt_crowd[j][gv] if gt_crowd is not None else None)
+
     # ------------------------------------------------------------- match
     def _evaluate_img(self, img_id: int, cat: int,
                       area_rng: Tuple[float, float], max_det: int

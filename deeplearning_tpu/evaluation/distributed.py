@@ -64,20 +64,27 @@ def gather_and_evaluate(shard: Dict, num_classes: int,
     seen = set()
     n_proc = gathered["image_ids"].shape[0]
     for p in range(n_proc):
-        for i in range(gathered["image_ids"].shape[1]):
-            if not gathered["image_valid"][p, i]:
+        ids = gathered["image_ids"][p]
+        # wrap-around duplicate safety folded into the image mask, then
+        # one batched fill per process row (arrays are already on host
+        # post-gather; add_batch keeps the per-image work to cheap slices)
+        valid = gathered["image_valid"][p].copy()
+        for i in range(ids.shape[0]):
+            if not valid[i]:
                 continue
-            img_id = int(gathered["image_ids"][p, i])
-            if img_id in seen:        # wrap-around duplicate safety
-                continue
-            seen.add(img_id)
-            dv = gathered["det_valid"][p, i]
-            gv = gathered["gt_valid"][p, i]
-            ev.add_image(
-                img_id,
-                gt_boxes=gathered["gt_boxes"][p, i][gv],
-                gt_labels=gathered["gt_labels"][p, i][gv],
-                det_boxes=gathered["det_boxes"][p, i][dv],
-                det_scores=gathered["det_scores"][p, i][dv],
-                det_labels=gathered["det_labels"][p, i][dv])
+            img_id = int(ids[i])
+            if img_id in seen:
+                valid[i] = False
+            else:
+                seen.add(img_id)
+        ev.add_batch(
+            ids,
+            det={"boxes": gathered["det_boxes"][p],
+                 "scores": gathered["det_scores"][p],
+                 "labels": gathered["det_labels"][p],
+                 "valid": gathered["det_valid"][p]},
+            gt={"boxes": gathered["gt_boxes"][p],
+                "labels": gathered["gt_labels"][p],
+                "valid": gathered["gt_valid"][p]},
+            image_valid=valid)
     return ev.summarize()

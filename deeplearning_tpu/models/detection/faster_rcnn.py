@@ -96,6 +96,7 @@ class FasterRCNN(nn.Module):
     fpn_channels: int = 256
     anchors_per_loc: int = 3
     roi_output_size: int = 7
+    roi_align_impl: str = "onepass"  # "onepass" packed-gather / "masked"
     dtype: Any = jnp.bfloat16
     backbone_frozen_bn: bool = False   # FrozenBatchNorm2d backbone stats
                                        # (resnet50_fpn.py:5); set True when
@@ -148,7 +149,8 @@ class FasterRCNN(nn.Module):
             pyr_slice = {k: pyramid[k][i] for k in align_levels}
             return multiscale_roi_align(
                 pyr_slice, run_props[i], self.roi_output_size,
-                strides={k: 2 ** int(k[1]) for k in align_levels})
+                strides={k: 2 ** int(k[1]) for k in align_levels},
+                impl=self.roi_align_impl)
 
         roi_feats = jax.vmap(roi_one)(jnp.arange(images.shape[0]))
         b, p = run_props.shape[:2]
@@ -183,7 +185,8 @@ def generate_proposals(outputs: Dict, anchors: jax.Array,
                        pre_nms_top_n: int = 1000,
                        post_nms_top_n: int = 256,
                        nms_thresh: float = 0.7,
-                       min_size: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+                       min_size: float = 1.0,
+                       nms_impl: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """(B, post_nms_top_n, 4) proposals + validity. Per-level pre-NMS
     top-k then joint NMS (rpn_function.py filter_proposals surface)."""
     level_counts = outputs["level_counts"]
@@ -208,7 +211,8 @@ def generate_proposals(outputs: Dict, anchors: jax.Array,
         cand_scores = jnp.concatenate(sel_scores, axis=0)
         keep_idx, keep_valid = nms_ops.nms(cand_boxes, cand_scores,
                                            nms_thresh, post_nms_top_n,
-                                           score_threshold=-1e8)
+                                           score_threshold=-1e8,
+                                           impl=nms_impl)
         props, = nms_ops.gather_nms_outputs(keep_idx, keep_valid, cand_boxes)
         return props, keep_valid
 
@@ -300,7 +304,8 @@ def fasterrcnn_postprocess(roi_scores: jax.Array, roi_deltas: jax.Array,
                            prop_valid: Optional[jax.Array] = None,
                            score_thresh: float = 0.05,
                            nms_thresh: float = 0.5,
-                           max_det: int = 100) -> Dict[str, jax.Array]:
+                           max_det: int = 100,
+                           nms_impl: str = "auto") -> Dict[str, jax.Array]:
     """Softmax → per-class decode → class-aware NMS → fixed max_det
     (roi_head.py:295-326 postprocess_detections surface). ``prop_valid``
     masks padded proposal slots out of the candidate pool (zero-area
@@ -324,9 +329,10 @@ def fasterrcnn_postprocess(roi_scores: jax.Array, roi_deltas: jax.Array,
         boxes = box_ops.clip_boxes(boxes, image_hw)
         keep_idx, keep_valid = nms_ops.batched_nms(
             boxes, fg_probs, classes, nms_thresh, max_det,
-            score_threshold=score_thresh)
+            score_threshold=score_thresh, impl=nms_impl)
         out_boxes, out_scores, out_classes = nms_ops.gather_nms_outputs(
-            keep_idx, keep_valid, boxes, fg_probs, classes)
+            keep_idx, keep_valid, boxes, fg_probs, classes,
+            fill=(0, 0, -1))
         return out_boxes, out_scores, out_classes, keep_valid
 
     boxes, scores, classes, valid = jax.vmap(per_image)(

@@ -195,7 +195,8 @@ def fcos_loss(outputs: Dict, targets: Dict) -> Dict[str, jax.Array]:
 def fcos_postprocess(outputs: Dict, locations: jax.Array,
                      image_hw: Tuple[int, int], score_thresh: float = 0.05,
                      nms_thresh: float = 0.6, topk: int = 1000,
-                     max_det: int = 100) -> Dict[str, jax.Array]:
+                     max_det: int = 100,
+                     nms_impl: str = "auto") -> Dict[str, jax.Array]:
     def per_image(cls_logits, ctr, ltrb):
         scores = jnp.sqrt(jax.nn.sigmoid(cls_logits)
                           * jax.nn.sigmoid(ctr)[:, None])
@@ -212,9 +213,10 @@ def fcos_postprocess(outputs: Dict, locations: jax.Array,
         cls_i = top_i % nc
         keep_idx, keep_valid = nms_ops.batched_nms(
             boxes[loc_i], top_s, cls_i, nms_thresh, max_det,
-            score_threshold=score_thresh)
+            score_threshold=score_thresh, impl=nms_impl)
         bsel, ssel, csel = nms_ops.gather_nms_outputs(
-            keep_idx, keep_valid, boxes[loc_i], top_s, cls_i)
+            keep_idx, keep_valid, boxes[loc_i], top_s, cls_i,
+            fill=(0, 0, -1))
         return bsel, ssel, csel, keep_valid
 
     boxes, scores, classes, valid = jax.vmap(per_image)(

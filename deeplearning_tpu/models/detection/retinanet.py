@@ -152,9 +152,14 @@ def retinanet_postprocess(outputs: Dict, anchors: jax.Array,
                           score_thresh: float = 0.05,
                           nms_thresh: float = 0.5,
                           topk_candidates: int = 1000,
-                          max_det: int = 100) -> Dict[str, jax.Array]:
+                          max_det: int = 100,
+                          nms_impl: str = "auto") -> Dict[str, jax.Array]:
     """Decode → top-k per image → class-aware NMS → fixed max_det outputs
-    (RetinaNet postprocess_detections surface, fixed-shape)."""
+    (RetinaNet postprocess_detections surface, fixed-shape).
+
+    ``nms_impl`` selects the NMS path (see ``ops.nms.nms``): "auto"
+    routes the 1000-candidate set through the blocked sweep (Pallas
+    kernel on TPU); "greedy" keeps the reference scan selectable."""
 
     def per_image(cls_logits, deltas):
         scores_all = jax.nn.sigmoid(cls_logits)          # (A, K)
@@ -168,9 +173,11 @@ def retinanet_postprocess(outputs: Dict, anchors: jax.Array,
         boxes = box_ops.clip_boxes(boxes, image_hw)
         keep_idx, keep_valid = nms_ops.batched_nms(
             boxes, top_scores, class_idx, nms_thresh, max_det,
-            score_threshold=score_thresh)
+            score_threshold=score_thresh, impl=nms_impl)
+        # padded slots: boxes/scores 0, class -1 (never a real class-0)
         out_boxes, out_scores, out_classes = nms_ops.gather_nms_outputs(
-            keep_idx, keep_valid, boxes, top_scores, class_idx)
+            keep_idx, keep_valid, boxes, top_scores, class_idx,
+            fill=(0, 0, -1))
         return out_boxes, out_scores, out_classes, keep_valid
 
     boxes, scores, classes, valid = jax.vmap(per_image)(

@@ -219,8 +219,8 @@ def yolov5_loss(raw: jax.Array, grid: Dict[str, jax.Array],
 
 def yolov5_postprocess(raw: jax.Array, grid: Dict[str, jax.Array],
                        score_thresh: float = 0.25,
-                       nms_thresh: float = 0.45, max_det: int = 100
-                       ) -> Dict[str, jax.Array]:
+                       nms_thresh: float = 0.45, max_det: int = 100,
+                       nms_impl: str = "auto") -> Dict[str, jax.Array]:
     decoded = decode_yolov5(raw, grid)
 
     def per_image(dec):
@@ -231,10 +231,10 @@ def yolov5_postprocess(raw: jax.Array, grid: Dict[str, jax.Array],
         best_score = jnp.max(conf, -1)
         keep_idx, keep_valid = nms_ops.batched_nms(
             dec[:, :4], best_score, best_cls, nms_thresh, max_det,
-            score_threshold=score_thresh)
+            score_threshold=score_thresh, impl=nms_impl)
         b, s, c = nms_ops.gather_nms_outputs(keep_idx, keep_valid,
                                              dec[:, :4], best_score,
-                                             best_cls)
+                                             best_cls, fill=(0, 0, -1))
         return b, s, c, keep_valid
 
     boxes, scores, classes, valid = jax.vmap(per_image)(decoded)

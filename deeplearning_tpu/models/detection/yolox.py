@@ -468,16 +468,17 @@ def yolox_loss(raw: jax.Array, centers: jax.Array, strides: jax.Array,
 
 def yolox_postprocess(raw: jax.Array, centers: jax.Array,
                       strides: jax.Array, score_thresh: float = 0.01,
-                      nms_thresh: float = 0.65, max_det: int = 100
-                      ) -> Dict[str, jax.Array]:
+                      nms_thresh: float = 0.65, max_det: int = 100,
+                      nms_impl: str = "auto") -> Dict[str, jax.Array]:
     decoded = decode_outputs(raw, centers, strides)
     return postprocess_decoded(decoded, score_thresh=score_thresh,
-                               nms_thresh=nms_thresh, max_det=max_det)
+                               nms_thresh=nms_thresh, max_det=max_det,
+                               nms_impl=nms_impl)
 
 
 def postprocess_decoded(decoded: jax.Array, score_thresh: float = 0.01,
-                        nms_thresh: float = 0.65, max_det: int = 100
-                        ) -> Dict[str, jax.Array]:
+                        nms_thresh: float = 0.65, max_det: int = 100,
+                        nms_impl: str = "auto") -> Dict[str, jax.Array]:
     """NMS postprocess over already-decoded (B, A, 5+C) predictions —
     split out of yolox_postprocess so TTA can merge several decoded
     variants (multi-scale/flip) along A and run ONE suppression pass."""
@@ -490,10 +491,10 @@ def postprocess_decoded(decoded: jax.Array, score_thresh: float = 0.01,
         best_score = jnp.max(scores_all, -1)
         keep_idx, keep_valid = nms_ops.batched_nms(
             dec[:, :4], best_score, best_cls, nms_thresh, max_det,
-            score_threshold=score_thresh)
+            score_threshold=score_thresh, impl=nms_impl)
         b, s, c = nms_ops.gather_nms_outputs(keep_idx, keep_valid,
                                              dec[:, :4], best_score,
-                                             best_cls)
+                                             best_cls, fill=(0, 0, -1))
         return b, s, c, keep_valid
 
     boxes, scores, classes, valid = jax.vmap(per_image)(decoded)

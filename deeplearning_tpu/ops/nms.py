@@ -7,28 +7,98 @@ return variable-length index lists — impossible under XLA's static shapes.
 TPU-first formulation: NMS(boxes, scores) → (keep_indices[max_out],
 keep_mask[max_out]) with fixed ``max_out``; suppressed slots are masked.
 
-Algorithm: O(max_out · N) greedy — each of ``max_out`` fixed iterations
-selects the argmax of the still-alive scores and suppresses neighbors over
-the IoU threshold. All dense vector math (VPU-friendly); no data-dependent
-shapes. ``batched_nms`` uses the reference's category-offset trick
+Two implementations behind one contract:
+
+``nms_reference`` — O(max_out · N) greedy: each of ``max_out`` fixed
+iterations selects the argmax of the still-alive scores and suppresses
+neighbors over the IoU threshold. Simple, but it materializes the full
+N×N IoU matrix up front (1.6 GB f32 at N=20k) and the per-step
+data-dependent argmax serializes the device for ``max_out`` steps.
+
+``nms_blocked`` — blocked bitmask sweep (the torchvision-CUDA /
+TF-TPU ``sorted_non_max_suppression_padded`` formulation): sort by score
+once, tile the sorted candidates into blocks of B, and process blocks
+in score order. Per block: resolve intra-block suppression by iterating
+the suppression relation to its (unique, = greedy) fixed point, then
+kill every *later* candidate that overlaps a kept box using one
+(B, N) IoU tile computed on the fly. Sequential depth is the number of
+blocks actually needed to collect ``max_out`` keeps (early exit), peak
+memory is O(N·B) — the N×N matrix is never materialized. Dense tiles
+are MXU/VPU-friendly; a Pallas kernel with the same contract lives in
+``ops/pallas/nms.py``.
+
+Both paths emit the identical keep set in the identical (descending
+score, stable) order — property-tested in tests/test_blocked_nms.py.
+``batched_nms`` uses the reference's category-offset trick
 (boxes.py:35-60) so classes never suppress each other.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 from .boxes import box_iou
 
+# Default tile width for the blocked sweep. 256 keeps the intra-block
+# (B, B) tile at 256 KB f32 and is a multiple of the TPU lane width.
+DEFAULT_BLOCK_SIZE = 256
 
-def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
-        max_out: int, score_threshold: float = float("-inf")
-        ) -> Tuple[jax.Array, jax.Array]:
+# nms(impl="auto") policy: below _AUTO_BLOCKED_MIN candidates the greedy
+# scan is cheaper than sort + tile bookkeeping; at/above it the blocked
+# sweep wins; on a TPU backend with >= _AUTO_PALLAS_MIN candidates the
+# Pallas kernel takes over (on CPU it would only add interpret overhead).
+_AUTO_BLOCKED_MIN = 256
+_AUTO_PALLAS_MIN = 1024
+
+_VALID_IMPLS = ("auto", "greedy", "reference", "blocked", "pallas")
+_default_impl = "auto"
+
+
+def set_default_nms_impl(impl: str) -> str:
+    """Set the library-wide default for ``nms(impl=None)`` calls; returns
+    the previous default. Accepts "auto", "greedy"/"reference",
+    "blocked" or "pallas"."""
+    global _default_impl
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"nms impl must be one of {_VALID_IMPLS}, "
+                         f"got {impl!r}")
+    prev = _default_impl
+    _default_impl = impl
+    return prev
+
+
+def get_default_nms_impl() -> str:
+    return _default_impl
+
+
+def _resolve_impl(impl: Optional[str], n: int) -> str:
+    impl = _default_impl if impl is None or impl == "auto" else impl
+    if impl == "reference":
+        return "greedy"
+    if impl == "auto":
+        if n < _AUTO_BLOCKED_MIN:
+            return "greedy"
+        if n >= _AUTO_PALLAS_MIN and jax.default_backend() == "tpu":
+            return "pallas"
+        return "blocked"
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"nms impl must be one of {_VALID_IMPLS}, "
+                         f"got {impl!r}")
+    return impl
+
+
+def nms_reference(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+                  max_out: int, score_threshold: float = float("-inf")
+                  ) -> Tuple[jax.Array, jax.Array]:
     """Greedy NMS. boxes (N,4), scores (N,) → (idx (max_out,), valid
-    (max_out,) bool). Padded slots have idx 0 and valid False."""
+    (max_out,) bool). Padded slots have idx 0 and valid False.
+
+    Kept as the equivalence oracle for the blocked/Pallas paths; its
+    full N×N IoU build makes it the wrong choice beyond a few hundred
+    candidates."""
     n = boxes.shape[0]
     iou = box_iou(boxes, boxes)
     alive = scores > score_threshold
@@ -47,25 +117,177 @@ def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
     return idx, valid
 
 
+def _emit_from_alive(alive: jax.Array, order: jax.Array, max_out: int
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Turn a keep mask over *sorted* (score-descending) positions into the
+    fixed-shape (idx[max_out], valid[max_out]) contract, without any
+    data-dependent shapes: rank kept positions by prefix count and
+    scatter their original indices into the output slots.
+
+    ``alive`` may contain stale True entries past the point where
+    ``max_out`` keeps were already collected (blocked early exit) —
+    those have rank >= max_out and are dropped by the scatter."""
+    npad = alive.shape[0]
+    n = order.shape[0]
+    rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    slot = jnp.where(alive & (rank < max_out), rank, max_out)
+    src = jnp.zeros((max_out,), jnp.int32).at[slot].set(
+        jnp.arange(npad, dtype=jnp.int32), mode="drop")
+    total = jnp.minimum(jnp.sum(alive.astype(jnp.int32)), max_out)
+    valid = jnp.arange(max_out, dtype=jnp.int32) < total
+    order_pad = jnp.zeros((npad,), order.dtype).at[:n].set(order)
+    idx = jnp.where(valid, order_pad[src], 0)
+    return idx, valid
+
+
+def sort_pad_candidates(boxes: jax.Array, scores: jax.Array,
+                        score_threshold: float, block_size: int):
+    """Shared blocked-NMS prologue: stable sort by descending score, pad
+    to a whole number of blocks. Returns (sboxes (Npad,4),
+    alive0 (Npad,) bool, order (N,) int, nb). Padded slots carry -inf
+    scores so they are never alive; NaN scores sort last and are dead
+    under any threshold (NaN > t is False), matching the greedy path."""
+    n = boxes.shape[0]
+    nb = max(1, -(-n // block_size))
+    npad = nb * block_size
+    order = jnp.argsort(-scores)  # stable → greedy argmax tie order
+    sboxes = jnp.zeros((npad, 4), boxes.dtype).at[:n].set(boxes[order])
+    sscores = jnp.full((npad,), -jnp.inf, scores.dtype).at[:n].set(
+        scores[order])
+    alive0 = sscores > score_threshold
+    return sboxes, alive0, order, nb
+
+
+def _intra_block_keep(blk_boxes: jax.Array, blk_alive: jax.Array,
+                      iou_threshold: float) -> jax.Array:
+    """Greedy keep set within one sorted block via fixed-point iteration.
+
+    With M[j,k] = 1 iff j<k and iou(j,k) > th (strictly upper
+    triangular), iterate A ← alive0 ∧ ¬(∃j: A[j] ∧ M[j,k]). Any fixed
+    point of that map equals the greedy set (induction over k), and
+    position k stabilizes after ≤ k+1 sweeps, so the loop converges in
+    ≤ B+1 iterations and usually far fewer."""
+    block = blk_boxes.shape[0]
+    iou_in = box_iou(blk_boxes, blk_boxes)
+    pos = jnp.arange(block)
+    sup_in = (iou_in > iou_threshold) & (pos[:, None] < pos[None, :])
+
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        keep, _ = state
+        new = blk_alive & ~jnp.any(sup_in & keep[:, None], axis=0)
+        return new, jnp.any(new != keep)
+
+    keep, _ = jax.lax.while_loop(cond, body, (blk_alive, jnp.asarray(True)))
+    return keep
+
+
+def nms_blocked(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+                max_out: int, score_threshold: float = float("-inf"),
+                block_size: int = DEFAULT_BLOCK_SIZE
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Blocked bitmask NMS — same contract and keep set as
+    ``nms_reference`` with O(N·B) peak memory and sequential depth
+    ceil(N/B), stopping early once ``max_out`` keeps are collected."""
+    block_size = int(min(block_size, max(8, boxes.shape[0])))
+    sboxes, alive0, order, nb = sort_pad_candidates(
+        boxes, scores, score_threshold, block_size)
+    npad = alive0.shape[0]
+    col = jnp.arange(npad)
+
+    def cond(state):
+        i, _, kept = state
+        return (i < nb) & (kept < max_out)
+
+    def body(state):
+        i, alive, kept = state
+        start = i * block_size
+        blk = jax.lax.dynamic_slice(sboxes, (start, 0), (block_size, 4))
+        blk_alive = jax.lax.dynamic_slice(alive, (start,), (block_size,))
+        keep = _intra_block_keep(blk, blk_alive, iou_threshold)
+        # One (B, Npad) tile kills every later candidate that overlaps a
+        # kept box. NaN boxes never suppress (NaN > th is False), same
+        # as the greedy path.
+        cross = box_iou(blk, sboxes)
+        hit = jnp.any((cross > iou_threshold) & keep[:, None], axis=0)
+        alive = alive & ~(hit & (col >= start + block_size))
+        alive = jax.lax.dynamic_update_slice(alive, keep, (start,))
+        return i + 1, alive, kept + jnp.sum(keep.astype(jnp.int32))
+
+    _, alive, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), alive0, jnp.asarray(0)))
+    return _emit_from_alive(alive, order, max_out)
+
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+        max_out: int, score_threshold: float = float("-inf"),
+        impl: Optional[str] = None, block_size: int = DEFAULT_BLOCK_SIZE
+        ) -> Tuple[jax.Array, jax.Array]:
+    """NMS dispatcher. boxes (N,4), scores (N,) → (idx (max_out,), valid
+    (max_out,) bool). Padded slots have idx 0 and valid False.
+
+    ``impl``: None → library default (``set_default_nms_impl``);
+    "auto" → greedy below 256 candidates, blocked above, Pallas kernel
+    on a TPU backend at >= 1024; or force "greedy"/"reference",
+    "blocked", "pallas"."""
+    resolved = _resolve_impl(impl, boxes.shape[0])
+    if resolved == "greedy":
+        return nms_reference(boxes, scores, iou_threshold, max_out,
+                             score_threshold)
+    if resolved == "pallas":
+        from .pallas import nms as pallas_nms  # lazy: avoids import cycle
+        return pallas_nms.nms_pallas(boxes, scores, iou_threshold, max_out,
+                                     score_threshold, block_size=block_size)
+    return nms_blocked(boxes, scores, iou_threshold, max_out,
+                       score_threshold, block_size=block_size)
+
+
 def batched_nms(boxes: jax.Array, scores: jax.Array, classes: jax.Array,
                 iou_threshold: float, max_out: int,
-                score_threshold: float = float("-inf")
+                score_threshold: float = float("-inf"),
+                impl: Optional[str] = None,
+                block_size: int = DEFAULT_BLOCK_SIZE
                 ) -> Tuple[jax.Array, jax.Array]:
     """Class-aware NMS via per-class coordinate offsets
-    (fasterRcnn utils/boxes.py:35-60 trick, fixed-shape)."""
-    max_coord = jnp.max(boxes) + 1.0
+    (fasterRcnn utils/boxes.py:35-60 trick, fixed-shape).
+
+    The offset scale is computed from *finite* boxes only: one NaN/inf
+    box (a decode overflow, a masked pad slot) must not poison
+    ``max_coord`` and with it every class offset. Non-finite boxes keep
+    their own coordinates — they already never suppress anything (NaN
+    IoU compares False) and can only be selected if their score says
+    so, same as plain ``nms``."""
+    finite = jnp.all(jnp.isfinite(boxes), axis=-1)
+    max_coord = jnp.max(jnp.where(finite[:, None], boxes, 0.0)) + 1.0
     offsets = classes.astype(boxes.dtype)[:, None] * max_coord
     return nms(boxes + offsets, scores, iou_threshold, max_out,
-               score_threshold)
+               score_threshold, impl=impl, block_size=block_size)
 
 
-def gather_nms_outputs(idx: jax.Array, valid: jax.Array, *arrays
+def gather_nms_outputs(idx: jax.Array, valid: jax.Array, *arrays,
+                       fill: Union[float, Sequence[float]] = 0
                        ) -> Tuple[jax.Array, ...]:
-    """Gather (boxes/scores/classes/...) at keep indices, zeroing padded
-    slots so downstream fixed-shape consumers see clean data."""
+    """Gather (boxes/scores/classes/...) at keep indices, overwriting
+    padded slots with ``fill`` so downstream fixed-shape consumers see
+    clean data.
+
+    ``fill`` is a scalar applied to every array, or one value per array.
+    Pass -1 for class arrays: a zero-filled padded slot is otherwise
+    indistinguishable from a real class-0 / score-0 detection in COCO
+    eval."""
+    if isinstance(fill, (tuple, list)):
+        if len(fill) != len(arrays):
+            raise ValueError(
+                f"gather_nms_outputs: got {len(arrays)} arrays but "
+                f"{len(fill)} fill values")
+        fills = fill
+    else:
+        fills = (fill,) * len(arrays)
     out = []
-    for a in arrays:
+    for a, f in zip(arrays, fills):
         g = a[idx]
         mask = valid.reshape(valid.shape + (1,) * (g.ndim - 1))
-        out.append(jnp.where(mask, g, 0))
+        out.append(jnp.where(mask, g, jnp.asarray(f, dtype=g.dtype)))
     return tuple(out)

@@ -5,10 +5,17 @@ The reference consumes torchvision's compiled RoIAlign
 each output cell samples a fixed ``sampling_ratio²`` grid of bilinear
 points — a dense gather, fully vectorized over (rois × cells × samples),
 which XLA lowers to efficient dynamic-gathers. FPN level assignment
-follows the canonical heuristic (level = 4 + log2(sqrt(area)/224), clamped)
-with per-level compute + masked combine (static shapes; every roi is
-evaluated once per level and selected, trading FLOPs for shape stability —
-cheap because roi grids are tiny).
+follows the canonical heuristic (level = 4 + log2(sqrt(area)/224),
+clamped).
+
+``multiscale_roi_align`` is **one-pass**: the pyramid levels are packed
+into a single flat (ΣH·W, C) buffer with static per-level row offsets,
+each RoI's sample coordinates are computed in its *assigned* level's
+frame, and one bilinear gather against the packed buffer samples every
+RoI exactly once — L× fewer FLOPs/gathers than evaluating each RoI on
+every level. The old evaluate-everywhere-and-mask formulation is kept
+as ``multiscale_roi_align_masked`` (equivalence oracle; see
+tests/test_detection_ops.py / test_blocked_nms.py parity tests).
 """
 
 from __future__ import annotations
@@ -70,6 +77,52 @@ def roi_align(features: jax.Array, rois: jax.Array, output_size: int,
     return jnp.mean(vals, axis=(2, 4))           # (R, S, S, C)
 
 
+def _assign_levels(feature_pyramid, rois, canonical_level, canonical_scale):
+    """Canonical FPN level per RoI → (sorted level names, per-roi index
+    into that list)."""
+    levels = sorted(feature_pyramid, key=lambda k: int(k[1]))
+    lmin, lmax = int(levels[0][1]), int(levels[-1][1])
+    areas = jnp.maximum(rois[:, 2] - rois[:, 0], 0) * \
+        jnp.maximum(rois[:, 3] - rois[:, 1], 0)
+    target = jnp.floor(canonical_level
+                       + jnp.log2(jnp.sqrt(areas) / canonical_scale + 1e-8))
+    target = jnp.clip(target, lmin, lmax).astype(jnp.int32)
+    return levels, target - lmin
+
+
+def _bilinear_packed(packed: jax.Array, y: jax.Array, x: jax.Array,
+                     h: jax.Array, w: jax.Array, off: jax.Array
+                     ) -> jax.Array:
+    """Per-RoI bilinear sampling against a flat packed (ΣH·W, C) buffer.
+
+    y/x: (R, ...) float coords in each RoI's own level frame; h/w/off:
+    (R,) that level's height, width and flat row offset. Identical
+    out-of-bounds/clip semantics to ``_bilinear`` — per-roi bounds keep
+    every flat index inside the roi's own level slab."""
+    expand = (slice(None),) + (None,) * (y.ndim - 1)
+    hf = h.astype(y.dtype)[expand]
+    wf = w.astype(y.dtype)[expand]
+    wi = w.astype(jnp.int32)[expand]
+    hi = h.astype(jnp.int32)[expand]
+    base = off.astype(jnp.int32)[expand]
+    in_bounds = (y >= -1.0) & (y <= hf) & (x >= -1.0) & (x <= wf)
+    y = jnp.clip(y, 0.0, hf - 1.0)
+    x = jnp.clip(x, 0.0, wf - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, hi - 1)
+    x1 = jnp.minimum(x0 + 1, wi - 1)
+    ly = (y - y0)[..., None]
+    lx = (x - x0)[..., None]
+    v00 = packed[base + y0 * wi + x0]
+    v01 = packed[base + y0 * wi + x1]
+    v10 = packed[base + y1 * wi + x0]
+    v11 = packed[base + y1 * wi + x1]
+    val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    return val * in_bounds[..., None]
+
+
 def multiscale_roi_align(
     feature_pyramid: Dict[str, jax.Array],
     rois: jax.Array,
@@ -78,25 +131,92 @@ def multiscale_roi_align(
     canonical_scale: float = 224.0,
     sampling_ratio: int = 2,
     strides: Dict[str, int] | None = None,
+    impl: str = "onepass",
 ) -> jax.Array:
     """FPN-aware RoIAlign (MultiScaleRoIAlign surface). feature_pyramid
-    maps 'p2'..'p5' → (H_l, W_l, C). Every roi is aligned on every level
-    then the assigned level is selected — static shapes, tiny grids."""
+    maps 'p2'..'p5' → (H_l, W_l, C); rois (R, 4) → (R, S, S, C).
+
+    One bilinear pass total: levels are flattened into a packed
+    (ΣH·W, C) buffer, each RoI's sample grid is laid out in its assigned
+    level's coordinate frame, and a single flat gather (4 corner reads)
+    samples all RoIs at once. ``impl="masked"`` selects the old
+    evaluate-every-level-and-mask reference."""
+    if impl == "masked":
+        return multiscale_roi_align_masked(
+            feature_pyramid, rois, output_size, canonical_level,
+            canonical_scale, sampling_ratio, strides)
+    if impl != "onepass":
+        raise ValueError(f"multiscale_roi_align impl must be 'onepass' or "
+                         f"'masked', got {impl!r}")
     if strides is None:
         strides = {k: 2 ** int(k[1]) for k in feature_pyramid}
-    areas = jnp.maximum(rois[:, 2] - rois[:, 0], 0) * \
-        jnp.maximum(rois[:, 3] - rois[:, 1], 0)
-    target = jnp.floor(canonical_level
-                       + jnp.log2(jnp.sqrt(areas) / canonical_scale + 1e-8))
-    levels = sorted(feature_pyramid, key=lambda k: int(k[1]))
-    lmin, lmax = int(levels[0][1]), int(levels[-1][1])
-    target = jnp.clip(target, lmin, lmax).astype(jnp.int32)
+    levels, lvl_idx = _assign_levels(feature_pyramid, rois,
+                                     canonical_level, canonical_scale)
+    # Static per-level geometry + one packed feature buffer.
+    hs, ws, offs, flats = [], [], [], []
+    row = 0
+    for name in levels:
+        f = feature_pyramid[name]
+        h, w, c = f.shape
+        hs.append(h)
+        ws.append(w)
+        offs.append(row)
+        row += h * w
+        flats.append(f.reshape(h * w, c))
+    packed = jnp.concatenate(flats, axis=0)
+    scale_tab = jnp.asarray([1.0 / strides[name] for name in levels],
+                            rois.dtype)
+    h_tab = jnp.asarray(hs, jnp.int32)
+    w_tab = jnp.asarray(ws, jnp.int32)
+    off_tab = jnp.asarray(offs, jnp.int32)
+
+    scale = scale_tab[lvl_idx]                       # (R,) per-roi
+    boxes = rois * scale[:, None]
+    s = output_size
+    sr = max(sampling_ratio, 1)
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    roi_w = jnp.maximum(x2 - x1, 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1.0)
+    bin_h = roi_h / s
+    bin_w = roi_w / s
+    iy = jnp.arange(s)
+    ir = jnp.arange(sr)
+    ys = (y1[:, None, None] + (iy[None, :, None]
+          + (ir[None, None, :] + 0.5) / sr) * bin_h[:, None, None])
+    xs = (x1[:, None, None] + (iy[None, :, None]
+          + (ir[None, None, :] + 0.5) / sr) * bin_w[:, None, None])
+    yy = jnp.broadcast_to(ys[:, :, :, None, None], ys.shape + (s, sr))
+    xx = jnp.broadcast_to(xs[:, None, None, :, :],
+                          (xs.shape[0], s, sr) + xs.shape[1:])
+    vals = _bilinear_packed(packed, yy, xx, h_tab[lvl_idx], w_tab[lvl_idx],
+                            off_tab[lvl_idx])       # (R, S, sr, S, sr, C)
+    return jnp.mean(vals, axis=(2, 4))              # (R, S, S, C)
+
+
+def multiscale_roi_align_masked(
+    feature_pyramid: Dict[str, jax.Array],
+    rois: jax.Array,
+    output_size: int = 7,
+    canonical_level: int = 4,
+    canonical_scale: float = 224.0,
+    sampling_ratio: int = 2,
+    strides: Dict[str, int] | None = None,
+) -> jax.Array:
+    """Reference formulation: every roi is aligned on every level then
+    the assigned level is selected by mask — L× redundant compute, kept
+    as the equivalence oracle for the one-pass path."""
+    if strides is None:
+        strides = {k: 2 ** int(k[1]) for k in feature_pyramid}
+    levels, lvl_idx = _assign_levels(feature_pyramid, rois,
+                                     canonical_level, canonical_scale)
+    lmin = int(levels[0][1])
 
     out = None
     for name in levels:
         lvl = int(name[1])
         aligned = roi_align(feature_pyramid[name], rois, output_size,
                             1.0 / strides[name], sampling_ratio)
-        sel = (target == lvl).astype(aligned.dtype)[:, None, None, None]
+        sel = (lvl_idx == lvl - lmin).astype(aligned.dtype)[:, None, None,
+                                                            None]
         out = aligned * sel if out is None else out + aligned * sel
     return out

@@ -73,7 +73,8 @@ def yolox_tta(raw_fn: Callable[[jax.Array], jax.Array],
               nms_thresh: float = 0.65,
               max_det: int = 100,
               grid_fn=None,
-              decode_fn=None) -> Dict[str, jax.Array]:
+              decode_fn=None,
+              nms_impl: str = "auto") -> Dict[str, jax.Array]:
     """Multi-scale + flip TTA for the YOLOX family.
 
     ``raw_fn(images) -> (B, A, 5+C)`` is the model forward (apply bound
@@ -109,4 +110,5 @@ def yolox_tta(raw_fn: Callable[[jax.Array], jax.Array],
         merged.append(jnp.concatenate([boxes, dec[..., 4:]], axis=-1))
     decoded = jnp.concatenate(merged, axis=1)
     return postprocess_decoded(decoded, score_thresh=score_thresh,
-                               nms_thresh=nms_thresh, max_det=max_det)
+                               nms_thresh=nms_thresh, max_det=max_det,
+                               nms_impl=nms_impl)

@@ -47,6 +47,24 @@ def append_result(path, variant, *, batch, step_ms, img_per_s, mfu_pct,
     return rec
 
 
+def append_op_result(path, op, *, n, ms, **extra):
+    """Append one OP-level microbench row (the ``--set detect`` sweep and
+    bench.py's CPU fallback section) to the same jsonl as the step-level
+    rows. Op rows carry {op, n, ms} instead of batch/step_ms/img_per_s so
+    consumers can split the two schemas with ``"op" in rec``."""
+    rec = {
+        "op": op,
+        "n": int(n),
+        "ms": round(float(ms), 3),
+        "device": jax.devices()[0].device_kind,
+        "utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    }
+    rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
 def feed_stats(source):
     """Device-feed telemetry columns for bench rows.
 

@@ -224,11 +224,93 @@ def time_feed_variant(name, batch, n_steps=20, depth=2,
     return dt, mfu
 
 
+def _detect_nms_case(rng, n):
+    ctr = rng.uniform(0, 2000, (n, 2))
+    wh = rng.uniform(4, 64, (n, 2))
+    boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2],
+                           axis=-1).astype(np.float32)
+    return jnp.asarray(boxes), jnp.asarray(
+        rng.uniform(0, 1, n).astype(np.float32))
+
+
+def time_detect_set(results_path=None):
+    """Detection postprocess sweep (ops/nms.py + ops/roi_align.py).
+
+    Op rows: greedy vs blocked NMS (plus the Pallas tile kernel on TPU)
+    at N in {2k, 20k}; one-pass vs masked multiscale RoIAlign at R in
+    {256, 1k}. End-to-end row: the jitted RetinaNet eval path (forward +
+    decode + blocked NMS), i.e. exactly what one eval step runs."""
+    import functools
+
+    from bench_util import append_op_result, append_result, bench
+    from deeplearning_tpu.ops import nms as nms_ops
+    from deeplearning_tpu.ops import roi_align as roi_ops
+
+    rng = np.random.default_rng(0)
+    impls = ["greedy", "blocked"]
+    if jax.default_backend() == "tpu":
+        impls.append("pallas")
+    for n in (2000, 20000):
+        boxes, scores = _detect_nms_case(rng, n)
+        for impl in impls:
+            fn = jax.jit(functools.partial(
+                nms_ops.nms, iou_threshold=0.5, max_out=100, impl=impl))
+            ms = bench(fn, (boxes, scores), n=10) * 1e3
+            print(f"nms_{impl:8s} n={n:6d} {ms:9.3f} ms", flush=True)
+            if results_path:
+                append_op_result(results_path, f"nms_{impl}", n=n, ms=ms)
+
+    pyr = {f"p{lvl}": jnp.asarray(rng.standard_normal(
+        (256 >> (lvl - 2), 256 >> (lvl - 2), 256)).astype(np.float32))
+        for lvl in (2, 3, 4, 5)}
+    for r in (256, 1000):
+        ctr = rng.uniform(20, 1000, (r, 2))
+        size = np.exp(rng.uniform(np.log(8), np.log(500), (r, 2)))
+        rois = jnp.asarray(np.clip(np.concatenate(
+            [ctr - size / 2, ctr + size / 2], -1), 0, 1023
+        ).astype(np.float32))
+        for impl in ("onepass", "masked"):
+            fn = jax.jit(functools.partial(
+                roi_ops.multiscale_roi_align, impl=impl))
+            ms = bench(fn, (pyr, rois), n=10) * 1e3
+            print(f"roi_{impl:9s} r={r:6d} {ms:9.3f} ms", flush=True)
+            if results_path:
+                append_op_result(results_path, f"roi_align_{impl}",
+                                 n=r, ms=ms)
+
+    # end-to-end eval path: the per-step unit of evaluation/coco_eval —
+    # one jitted forward + postprocess over a padded batch
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.models.detection.retinanet import (
+        retinanet_anchors, retinanet_postprocess)
+    img, batch = 512, 8
+    model = MODELS.build("retinanet_resnet18_fpn", num_classes=80)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, img, img, 3)), train=False)
+    anchors = jnp.asarray(retinanet_anchors((img, img)))
+
+    @jax.jit
+    def eval_step(images):
+        out = model.apply(variables, images, train=False)
+        return retinanet_postprocess(out, anchors, (img, img),
+                                     max_det=100, nms_impl="auto")
+
+    images = jnp.asarray(rng.normal(
+        size=(batch, img, img, 3)).astype(np.float32))
+    dt = bench(eval_step, (images,), n=10)
+    print(f"retinanet_eval_step batch={batch} {dt * 1e3:9.2f} ms "
+          f"img/s={batch / dt:8.1f}", flush=True)
+    if results_path:
+        append_result(results_path, "retinanet_eval_e2e", batch=batch,
+                      step_ms=dt * 1e3, img_per_s=batch / dt, mfu_pct=0.0,
+                      model="retinanet_resnet18_fpn", image_size=img)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
                     choices=["batch", "attn", "all", "r5", "decomp",
-                             "feed"])
+                             "feed", "detect"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -254,6 +336,8 @@ def main():
                      attn_fn=bf16_softmax_attention, results_path=results)
         with patch_embed_as_conv():
             time_variant("patch_conv_b128", 128, results_path=results)
+    if args.set == "detect":
+        time_detect_set(results_path=results)
     if args.set == "feed":
         # feed-side A/B for the MFU claim: serial blocking H2D vs the
         # threaded prefetch pipeline, same step, real per-iter batches

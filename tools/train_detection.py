@@ -49,6 +49,9 @@ class DetModelCfg:
                                       # NMS (rpn_function.py post_nms_top_n)
     rcnn_roi_batch: int = 128         # fasterrcnn sampled rois per image
                                       # (roi_head batch_size_per_image)
+    nms_impl: str = "auto"            # NMS path for every postprocess
+                                      # (ops/nms.py): auto | blocked |
+                                      # pallas | greedy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,13 +126,15 @@ def synthetic_boxes(n: int, size: int, num_classes: int, max_gt: int,
 
 
 def build_task(model, name: str, num_classes: int, score_thresh: float,
-               max_det: int = 10, rcnn_kw: Optional[dict] = None):
+               max_det: int = 10, rcnn_kw: Optional[dict] = None,
+               nms_impl: str = "auto"):
     """Family dispatch. Returns
     (loss_fn(params, stats, batch, rng) -> (total_loss, new_stats),
      predict_fn(params, stats, images) -> padded det dict).
     The image size is read from the traced batch shape, so grids/anchors
     are rebuilt per multi-scale bucket. ``rcnn_kw``: fasterrcnn sizing
-    (post_nms_top_n, roi_batch)."""
+    (post_nms_top_n, roi_batch). ``nms_impl`` selects the suppression
+    path for every family's postprocess (ops/nms.py)."""
     rcnn_kw = rcnn_kw or {}
 
     def apply_train(params, stats, images, **kw):
@@ -159,7 +164,7 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             out = apply_eval(params, stats, images)
             return retinanet_postprocess(
                 out, jnp.asarray(retinanet_anchors(hw)), hw, max_det=max_det,
-                score_thresh=score_thresh)
+                score_thresh=score_thresh, nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("yolox"):
@@ -181,7 +186,8 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
             out = apply_eval(params, stats, images)
             return yolox_postprocess(out, centers, strides, max_det=max_det,
-                                     score_thresh=score_thresh)
+                                     score_thresh=score_thresh,
+                                     nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("yolov5"):
@@ -204,7 +210,8 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
                     for k, v in yolov5_grid(hw).items()}
             out = apply_eval(params, stats, images)
             return yolov5_postprocess(out, grid, max_det=max_det,
-                                      score_thresh=score_thresh)
+                                      score_thresh=score_thresh,
+                                      nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("fcos"):
@@ -226,7 +233,9 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             locs, _ = fcos_locations(hw)
             out = apply_eval(params, stats, images)
             return fcos_postprocess(out, jnp.asarray(locs), hw,
-                                    max_det=max_det, score_thresh=score_thresh)
+                                    max_det=max_det,
+                                    score_thresh=score_thresh,
+                                    nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("fasterrcnn"):
@@ -253,7 +262,8 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             r = rpn_loss(out, anchors, batch["boxes"], batch["valid"],
                          rng)
             props, pvalid = generate_proposals(out, anchors, hw,
-                                               post_nms_top_n=post_nms)
+                                               post_nms_top_n=post_nms,
+                                               nms_impl=nms_impl)
             samples = sample_rois(
                 jax.lax.stop_gradient(props), pvalid, batch["boxes"],
                 labels1, batch["valid"], rng,
@@ -274,12 +284,14 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             anchors = jnp.asarray(fasterrcnn_anchors(hw))
             out = apply_eval(params, stats, images)
             props, pvalid = generate_proposals(out, anchors, hw,
-                                               post_nms_top_n=post_nms)
+                                               post_nms_top_n=post_nms,
+                                               nms_impl=nms_impl)
             out2 = apply_eval(params, stats, images, proposals=props,
                               pyramid=out["pyramid"])
             det = fasterrcnn_postprocess(
                 out2["roi_scores"], out2["roi_deltas"], props, hw,
-                prop_valid=pvalid, score_thresh=score_thresh, max_det=max_det)
+                prop_valid=pvalid, score_thresh=score_thresh, max_det=max_det,
+                nms_impl=nms_impl)
             det["labels"] = det["labels"] - 1      # back to 0-based fg
             return det
         return loss_fn, predict_fn
@@ -427,7 +439,8 @@ def run(cfg) -> dict:
         model, cfg.model.name, num_classes, cfg.train.eval_score_thresh,
         max_det=eval_max_det,
         rcnn_kw=dict(post_nms_top_n=cfg.model.rcnn_post_nms_top_n,
-                     roi_batch=cfg.model.rcnn_roi_batch))
+                     roi_batch=cfg.model.rcnn_roi_batch),
+        nms_impl=cfg.model.nms_impl)
     variables = model.init(jax.random.key(cfg.train.seed),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, stats = variables["params"], variables.get("batch_stats", {})
@@ -524,7 +537,10 @@ def run(cfg) -> dict:
         if it % max(cfg.train.steps // 5, 1) == 0:
             print(f"step {it}: loss={float(total):.4f}")
 
-    # ---- evaluate: coco mode on the held-out split, else train set
+    # ---- evaluate: coco mode on the held-out split, else train set.
+    # One jitted batched postprocess per eval step; the whole padded
+    # batch lands on the host in one transfer (CocoEvaluator.add_batch),
+    # no per-image device slicing.
     def eval_with(pred_fn, tag=""):
         ev = CocoEvaluator(num_classes=num_classes)
         pred_jit = jax.jit(pred_fn)
@@ -539,26 +555,17 @@ def run(cfg) -> dict:
                 sample = val_src[idx]
                 det = pred_jit(params, stats,
                                jnp.asarray(sample["image"]))
-                for j in range(n_real):
-                    keep = np.asarray(det["valid"][j])
-                    gv = sample["valid"][j]
-                    ev.add_image(
-                        start + j,
-                        gt_boxes=sample["boxes"][j][gv],
-                        gt_labels=sample["labels"][j][gv],
-                        det_boxes=np.asarray(det["boxes"][j])[keep],
-                        det_scores=np.asarray(det["scores"][j])[keep],
-                        det_labels=np.asarray(det["labels"][j])[keep])
+                ev.add_batch(
+                    np.arange(start, start + bs), det,
+                    gt={"boxes": sample["boxes"],
+                        "labels": sample["labels"],
+                        "valid": sample["valid"]},
+                    image_valid=np.arange(bs) < n_real)
         else:
             det = pred_jit(params, stats, jnp.asarray(images))
-            for i in range(len(images)):
-                keep = np.asarray(det["valid"][i])
-                ev.add_image(
-                    i, gt_boxes=boxes[i][valid[i]],
-                    gt_labels=labels[i][valid[i]],
-                    det_boxes=np.asarray(det["boxes"][i])[keep],
-                    det_scores=np.asarray(det["scores"][i])[keep],
-                    det_labels=np.asarray(det["labels"][i])[keep])
+            ev.add_batch(np.arange(len(images)), det,
+                         gt={"boxes": boxes, "labels": labels,
+                             "valid": valid})
         summary = ev.summarize()
         print(tag + str({k: round(v, 4) for k, v in summary.items()}))
         return summary
