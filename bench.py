@@ -151,6 +151,40 @@ def _cpu_op_microbench():
     return out
 
 
+def _serve_smoke():
+    """Serving-path smoke on the host CPU: one warmed engine at buckets
+    {1, 8}, the loadgen sequential baseline vs an 8-client closed loop.
+    Small enough to ride inside the bench deadline, quantitative enough
+    to show the dynamic-batching win (req/s + occupancy) in every bench
+    record — including wedged-tunnel rounds, since the serve stack is
+    backend-agnostic."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        from loadgen import make_images, run_closed_loop, run_sequential
+
+        from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+        engine = InferenceEngine("mnist_fcn", num_classes=10,
+                                 image_size=28, batch_buckets=(1, 8))
+        images = make_images(8, 28)
+        seq = run_sequential(engine, images, 64)
+        with MicroBatcher(engine, max_wait_ms=5.0) as mb:
+            closed = run_closed_loop(mb, images, concurrency=8,
+                                     n_requests=64)
+    return {
+        "backend": "cpu",
+        "sequential_req_per_s": seq["req_per_s"],
+        "closed8_req_per_s": closed["req_per_s"],
+        "speedup": round(closed["req_per_s"]
+                         / max(seq["req_per_s"], 1e-9), 2),
+        "closed8_p99_ms": closed["p99_ms"],
+        "batch_occupancy": closed["batch_occupancy"],
+        "compile_count": engine.compile_count,
+    }
+
+
 def _health_probe():
     """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
     must complete within _PROBE_DEADLINE_S, else report and exit instead of
@@ -170,6 +204,10 @@ def _health_probe():
                 cpu_fallback = _cpu_op_microbench()
             except Exception as e:  # noqa: BLE001 - fallback best-effort
                 cpu_fallback = {"error": repr(e)}
+            try:
+                cpu_fallback["serve"] = _serve_smoke()
+            except Exception as e:  # noqa: BLE001 - fallback best-effort
+                cpu_fallback["serve"] = {"error": repr(e)}
             print(json.dumps({
                 "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
                 "vs_baseline": 0.0, "error": "health probe timeout: device "
@@ -274,6 +312,12 @@ def main():
         "device": jax.devices()[0].device_kind,
         "batch": batch,
     }
+    try:
+        # serving-path smoke (CPU, a few seconds): rides along so every
+        # bench record also tracks the request-path regression surface
+        rec["serve"] = _serve_smoke()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["serve"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["load", "list_models"]
+__all__ = ["load", "list_models", "serve"]
 
 
 def list_models(filter: str = "") -> list:
@@ -58,3 +58,17 @@ def load(name: str, *, num_classes: int = 1000,
         return model.apply(variables, x, train=False)
 
     return model, variables, forward
+
+
+def serve(name: str, *, num_classes: int = 1000,
+          ckpt: Optional[str] = None, image_size: int = 224,
+          batch_buckets: Tuple[int, ...] = (1, 8, 32, 128),
+          **engine_kw):
+    """One-line serving session: ``hub.serve("resnet18", ...)`` returns
+    a warmed ``serve.InferenceEngine`` (bucketed AOT executables, zero
+    compiles after this call). Wrap it in ``serve.MicroBatcher`` for the
+    concurrent request path — see README "Serving policy"."""
+    from .serve import InferenceEngine
+    return InferenceEngine(name, num_classes=num_classes, ckpt=ckpt,
+                           image_size=image_size,
+                           batch_buckets=batch_buckets, **engine_kw)

@@ -1,2 +1,3 @@
-from . import (faster_rcnn, fcos, fpn, retinanet, yolo_builder,  # noqa: F401
-               yolov5, yolox)
+from . import (faster_rcnn, fcos, fpn, predict, retinanet,  # noqa: F401
+               yolo_builder, yolov5, yolox)
+from .predict import build_predict_fn, is_detection_model  # noqa: F401

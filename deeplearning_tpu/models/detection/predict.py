@@ -1,0 +1,128 @@
+"""Family-dispatch inference builder for every registry detector.
+
+The eval half of ``tools/train_detection.build_task`` (retinanet /
+yolox / yolov5 / fcos / fasterrcnn), moved into the package so
+non-training surfaces — the serving engine (``deeplearning_tpu.serve``),
+``tools/predict.py``, ``tools/demo.py`` — can build a fixed-shape
+postprocessed forward without importing a training CLI. ``build_task``
+delegates its predict halves here; there is exactly ONE definition of
+"run this detector and decode its boxes" in the repo.
+
+Every returned ``predict_fn(params, batch_stats, images)`` is pure and
+jit/AOT-friendly: fixed ``max_det`` output slots, padded rows carrying
+class −1 (the PR 3 padding convention — never a real class), and the
+image size read from the traced batch shape so grids/anchors rebuild per
+static bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_predict_fn", "is_detection_model", "DETECTION_PREFIXES"]
+
+DETECTION_PREFIXES = ("retinanet", "yolox", "yolov5", "fcos", "fasterrcnn")
+
+
+def is_detection_model(name: str) -> bool:
+    """True when ``name`` belongs to a detection family this module can
+    postprocess (the task auto-detect used by serve/ and predict.py)."""
+    return name.startswith(DETECTION_PREFIXES)
+
+
+def build_predict_fn(model, name: str, num_classes: int, *,
+                     score_thresh: float = 0.05, max_det: int = 100,
+                     post_nms_top_n: int = 256,
+                     nms_impl: str = "auto") -> Callable:
+    """Return ``predict_fn(params, batch_stats, images) -> det dict``
+    ({boxes, scores, labels, valid}, fixed shapes) for any registry
+    detector. ``post_nms_top_n`` sizes the fasterrcnn proposal stage;
+    ``nms_impl`` selects the suppression path (ops/nms.py) for every
+    family."""
+
+    def apply_eval(params, stats, images, **kw):
+        return model.apply({"params": params, "batch_stats": stats},
+                           images, train=False, **kw)
+
+    if name.startswith("retinanet"):
+        from .retinanet import retinanet_anchors, retinanet_postprocess
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            out = apply_eval(params, stats, images)
+            return retinanet_postprocess(
+                out, jnp.asarray(retinanet_anchors(hw)), hw,
+                max_det=max_det, score_thresh=score_thresh,
+                nms_impl=nms_impl)
+        return predict_fn
+
+    if name.startswith("yolox"):
+        from .yolox import yolox_grid, yolox_postprocess
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
+            out = apply_eval(params, stats, images)
+            return yolox_postprocess(out, centers, strides,
+                                     max_det=max_det,
+                                     score_thresh=score_thresh,
+                                     nms_impl=nms_impl)
+        return predict_fn
+
+    if name.startswith("yolov5"):
+        from .yolov5 import yolov5_grid, yolov5_postprocess
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            grid = {k: jnp.asarray(v) for k, v in yolov5_grid(hw).items()}
+            out = apply_eval(params, stats, images)
+            return yolov5_postprocess(out, grid, max_det=max_det,
+                                      score_thresh=score_thresh,
+                                      nms_impl=nms_impl)
+        return predict_fn
+
+    if name.startswith("fcos"):
+        from .fcos import fcos_locations, fcos_postprocess
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            locs, _ = fcos_locations(hw)
+            out = apply_eval(params, stats, images)
+            return fcos_postprocess(out, jnp.asarray(locs), hw,
+                                    max_det=max_det,
+                                    score_thresh=score_thresh,
+                                    nms_impl=nms_impl)
+        return predict_fn
+
+    if name.startswith("fasterrcnn"):
+        # two-stage: proposals from the RPN heads, RoI stage on the SAME
+        # pyramid (no backbone recompute). The model's class space is
+        # num_classes+1 with 0 = background; detections shift -1 back to
+        # the caller's 0-based foreground ids.
+        from .faster_rcnn import (fasterrcnn_anchors,
+                                  fasterrcnn_postprocess,
+                                  generate_proposals)
+
+        def predict_fn(params, stats, images):
+            hw = images.shape[1:3]
+            anchors = jnp.asarray(fasterrcnn_anchors(hw))
+            out = apply_eval(params, stats, images)
+            props, pvalid = generate_proposals(
+                out, anchors, hw, post_nms_top_n=post_nms_top_n,
+                nms_impl=nms_impl)
+            out2 = apply_eval(params, stats, images, proposals=props,
+                              pyramid=out["pyramid"])
+            det = fasterrcnn_postprocess(
+                out2["roi_scores"], out2["roi_deltas"], props, hw,
+                prop_valid=pvalid, score_thresh=score_thresh,
+                max_det=max_det, nms_impl=nms_impl)
+            det["labels"] = det["labels"] - 1      # back to 0-based fg
+            return det
+        return predict_fn
+
+    raise ValueError(f"no detection predict path for model {name!r} "
+                     "(expected retinanet*/fasterrcnn*/yolox*/yolov5*/"
+                     "fcos*)")

@@ -1,0 +1,28 @@
+"""TPU-native batched inference serving.
+
+The request-path counterpart of the training stack: an
+``InferenceEngine`` (one resident model session, bucketed AOT
+executables precompiled at startup), a ``MicroBatcher`` (dynamic
+micro-batching on a dedicated dispatch thread), admission control
+(bounded queue + deadlines + overload shedding), and sync-free
+telemetry. CLIs: ``tools/serve.py`` (server), ``tools/loadgen.py``
+(load generator), ``tools/predict.py`` (one-shot client).
+
+    from deeplearning_tpu import serve
+    engine = serve.InferenceEngine("resnet18", num_classes=10,
+                                   image_size=96, batch_buckets=(1, 8))
+    with serve.MicroBatcher(engine) as mb:
+        handle = mb.submit(image)          # (96, 96, 3) model-ready
+        probs = handle.result(timeout=1.0)
+
+See README "Serving policy" for the bucket table and overload rules.
+"""
+
+from .admission import AdmissionController, DeadlineExceeded, Rejected
+from .batcher import MicroBatcher, SubmitHandle
+from .engine import InferenceEngine
+from .telemetry import ServeTelemetry
+
+__all__ = ["InferenceEngine", "MicroBatcher", "SubmitHandle",
+           "AdmissionController", "Rejected", "DeadlineExceeded",
+           "ServeTelemetry"]

@@ -1,0 +1,129 @@
+"""Admission control: bounded queues, deadlines, and overload shedding.
+
+A serving queue with no admission policy converts overload into
+unbounded latency — every request is eventually served, long after its
+caller stopped waiting. This module makes the three overload decisions
+explicit and testable, decoupled from the batcher mechanics:
+
+- **Backpressure**: the queue has a hard depth bound. A submit against a
+  full queue raises ``Rejected`` carrying a ``retry_after_s`` hint
+  (estimated from the recent drain rate) instead of enqueueing — the
+  client sees a fast 429, not a slow timeout.
+- **Deadlines**: every request may carry an absolute deadline. The
+  dispatcher drops expired requests *before* padding them into an
+  executable (``DeadlineExceeded`` on the future) — device cycles are
+  never spent on an answer nobody is waiting for.
+- **Degradation**: past ``shed_threshold`` queued requests the policy
+  stops optimizing latency and targets the LARGEST batch bucket only
+  (max throughput per dispatch), reporting the shed via telemetry so
+  operators see the mode switch, not just a p99 cliff.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+__all__ = ["AdmissionController", "Rejected", "DeadlineExceeded"]
+
+
+class Rejected(Exception):
+    """Queue-full backpressure: retry after ``retry_after_s`` seconds."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"serve queue full ({depth} pending); "
+            f"retry after {retry_after_s:.3f}s")
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class AdmissionController:
+    """Pure policy object consulted by the batcher (no threads, no
+    queue ownership — everything takes the observed depth as input, so
+    tests drive it directly).
+
+    - ``max_queue``: hard pending-request bound (backpressure trigger).
+    - ``shed_threshold``: depth at which batching degrades to
+      largest-bucket-only dispatch (default: the largest bucket — once a
+      full max-throughput batch is waiting, padding smaller buckets only
+      burns cycles).
+    - ``default_timeout_s``: deadline applied to requests that don't
+      carry one (None = wait forever).
+    """
+
+    def __init__(self, buckets: Sequence[int], *, max_queue: int = 256,
+                 shed_threshold: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None):
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("admission needs at least one batch bucket")
+        self.max_queue = int(max_queue)
+        self.shed_threshold = (int(shed_threshold) if shed_threshold
+                               is not None else self.buckets[-1])
+        self.default_timeout_s = default_timeout_s
+        # drain-rate estimate for retry_after hints (EWMA of req/s seen
+        # at each dispatch; updated by the batcher)
+        self._drain_rate = 0.0
+
+    # ----------------------------------------------------- backpressure
+    def admit(self, queue_depth: int) -> None:
+        """Raise ``Rejected`` when the queue cannot take one more."""
+        if queue_depth >= self.max_queue:
+            raise Rejected(queue_depth, self.retry_after_s(queue_depth))
+
+    def retry_after_s(self, queue_depth: int) -> float:
+        """Time until the backlog plausibly has room: depth over the
+        observed drain rate, clamped to a sane hint window."""
+        if self._drain_rate > 0:
+            return min(max(queue_depth / self._drain_rate, 1e-3), 30.0)
+        return 0.05     # no throughput observed yet: cheap quick retry
+
+    def note_drained(self, n: int, seconds: float) -> None:
+        """EWMA drain-rate update from the batcher: ``n`` requests left
+        the queue over ``seconds`` of dispatch."""
+        if seconds <= 0:
+            return
+        rate = n / seconds
+        self._drain_rate = (rate if self._drain_rate == 0.0
+                            else 0.8 * self._drain_rate + 0.2 * rate)
+
+    # -------------------------------------------------------- deadlines
+    def deadline_for(self, timeout_s: Optional[float],
+                     now: Optional[float] = None) -> Optional[float]:
+        """Absolute deadline for a new request (None = no deadline)."""
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.default_timeout_s)
+        if timeout_s is None:
+            return None
+        return (now if now is not None else time.perf_counter()) \
+            + timeout_s
+
+    @staticmethod
+    def expired(deadline: Optional[float],
+                now: Optional[float] = None) -> bool:
+        if deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) \
+            >= deadline
+
+    # ------------------------------------------------------ degradation
+    def overloaded(self, queue_depth: int) -> bool:
+        return queue_depth >= self.shed_threshold
+
+    def target_bucket(self, queue_depth: int) -> int:
+        """Batch size the dispatcher should accumulate toward. Normal
+        mode: the smallest bucket admitting the current backlog (+1 for
+        the request already popped), so light traffic dispatches
+        immediately at small buckets. Overload: the largest bucket only."""
+        if self.overloaded(queue_depth):
+            return self.buckets[-1]
+        want = queue_depth + 1
+        for b in self.buckets:
+            if b >= want:
+                return b
+        return self.buckets[-1]

@@ -1,0 +1,254 @@
+"""Dynamic micro-batching: many concurrent requests, one device stream.
+
+The throughput case for serving on a TPU is the same as for training:
+the chip wants large static batches, clients send batch-1 requests. The
+``MicroBatcher`` closes the gap with the ``DevicePrefetcher`` worker
+discipline — one dedicated dispatch thread owns the device, everything
+else talks to it through a queue:
+
+1. ``submit()`` runs admission control (backpressure/deadline stamping),
+   enqueues a request, and returns a ``SubmitHandle`` future.
+2. The dispatch thread pops the first waiting request, then accumulates
+   followers until the admission policy's target bucket is full or
+   ``max_wait_ms`` expires — light traffic dispatches immediately in the
+   smallest bucket, bursts fill big buckets.
+3. The batch is padded to its bucket, run through the engine's AOT
+   executable (never a compile), and demultiplexed: each request's
+   future resolves to ITS row of the device outputs. Padding rows are
+   sliced away here and never observable (detection padding additionally
+   carries class −1 inside each row's fixed-shape slots, PR 3).
+
+The dispatch thread never materializes device values — demux is an
+async row-slice, latency bookkeeping is host timestamps — so a slow
+client can never stall batch formation (the ``async_metrics`` rule:
+syncs happen on the thread that wants the number).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .admission import AdmissionController, DeadlineExceeded
+from .telemetry import ServeTelemetry
+
+__all__ = ["MicroBatcher", "SubmitHandle"]
+
+
+class _Request:
+    __slots__ = ("rid", "image", "future", "deadline", "t_submit")
+
+    def __init__(self, rid, image, future, deadline, t_submit):
+        self.rid = rid
+        self.image = image
+        self.future = future
+        self.deadline = deadline
+        self.t_submit = t_submit
+
+
+class _SharedBatch:
+    """One dispatched batch's DEVICE outputs with a lazily-cached host
+    copy. The dispatch thread only wraps the output tree (no sync); the
+    FIRST requester to ask pays one bulk D2H for the whole batch, every
+    other row rides the cache — N clients cost one transfer, not N
+    row-sliced dispatches."""
+
+    __slots__ = ("_device", "_host", "_lock")
+
+    def __init__(self, device_tree: Any):
+        self._device = device_tree
+        self._host = None
+        self._lock = threading.Lock()
+
+    def row(self, i: int) -> Any:
+        with self._lock:
+            if self._host is None:
+                self._host = jax.tree.map(np.asarray, self._device)
+                self._device = None     # free HBM once host copy exists
+        return jax.tree.map(lambda a: a[i], self._host)
+
+
+class SubmitHandle:
+    """Per-request future. ``result()`` blocks for the demuxed row and
+    materializes it on the CALLING thread (the D2H lands on the
+    requester, keeping the dispatcher sync-free), recording e2e latency
+    into telemetry exactly once."""
+
+    def __init__(self, rid: int, future: Future, t_submit: float,
+                 telemetry: Optional[ServeTelemetry]):
+        self.rid = rid
+        self._future = future
+        self._t_submit = t_submit
+        self._telemetry = telemetry
+        self._recorded = False
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        shared, i = self._future.result(timeout)
+        out = shared.row(i)
+        if not self._recorded and self._telemetry is not None:
+            self._recorded = True
+            self._telemetry.record_e2e_latency(
+                time.perf_counter() - self._t_submit)
+        return out
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._future.exception(timeout)
+
+
+class MicroBatcher:
+    """Dynamic micro-batching front of an ``InferenceEngine``.
+
+    - ``max_wait_ms``: how long the dispatcher holds an underfull batch
+      open for followers before padding and going (the latency the
+      lightest-traffic request pays for batching).
+    - ``admission``: an ``AdmissionController``; defaults to one sized
+      on the engine's buckets with ``max_queue`` pending requests.
+    - Runs its dispatch thread from construction; ``close()`` (or the
+      context manager) drains and stops it.
+    """
+
+    def __init__(self, engine, *, max_wait_ms: float = 5.0,
+                 max_queue: int = 256,
+                 default_timeout_s: Optional[float] = None,
+                 admission: Optional[AdmissionController] = None,
+                 telemetry: Optional[ServeTelemetry] = None,
+                 start: bool = True):
+        self.engine = engine
+        self.max_wait_s = max_wait_ms / 1e3
+        self.admission = admission or AdmissionController(
+            engine.buckets, max_queue=max_queue,
+            default_timeout_s=default_timeout_s)
+        self.telemetry = telemetry or ServeTelemetry()
+        self._q: "queue.Queue[_Request]" = queue.Queue()
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="serve-dispatch",
+                daemon=True)
+            self._thread.start()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, image, timeout_s: Optional[float] = None
+               ) -> SubmitHandle:
+        """Admit one request. Raises ``serve.Rejected`` on a full queue
+        (backpressure, with a retry-after hint); the returned handle's
+        ``result()`` raises ``DeadlineExceeded`` if the request expired
+        before dispatch. ``image`` must be one model-ready
+        (image_size, image_size, 3) frame — resizing/normalizing is the
+        client's job (tools/serve.py does it for files)."""
+        size = self.engine.image_size
+        image = np.asarray(image, np.float32)
+        if image.shape != (size, size, 3):
+            raise ValueError(f"request image shape {image.shape} != "
+                             f"({size}, {size}, 3); resize client-side")
+        try:
+            self.admission.admit(self._q.qsize())
+        except Exception:
+            self.telemetry.record_reject()
+            raise
+        now = time.perf_counter()
+        req = _Request(next(self._ids), image, Future(),
+                       self.admission.deadline_for(timeout_s, now), now)
+        self.telemetry.record_submit()
+        self._q.put(req)
+        return SubmitHandle(req.rid, req.future, now, self.telemetry)
+
+    # --------------------------------------------------------- dispatch
+    def _expire(self, req: _Request, now: float) -> bool:
+        """Cancel a request whose deadline passed BEFORE spending device
+        time on it; True when the request was dropped."""
+        if self.admission.expired(req.deadline, now):
+            req.future.set_exception(DeadlineExceeded(
+                f"request {req.rid} expired after "
+                f"{now - req.t_submit:.3f}s in queue"))
+            self.telemetry.record_timeout()
+            return True
+        return False
+
+    def _collect(self) -> list:
+        """Block for one request, then hold the batch open for followers
+        until the LARGEST bucket fills or ``max_wait_ms`` expires — a
+        burst rides one big executable, a lone request pays at most
+        ``max_wait_ms`` extra latency before going out in bucket 1."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        t0 = time.perf_counter()
+        batch = [] if self._expire(first, t0) else [first]
+        wait_until = t0 + self.max_wait_s
+        big = self.engine.buckets[-1]
+        while len(batch) < big:
+            remaining = wait_until - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                req = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if not self._expire(req, time.perf_counter()):
+                batch.append(req)
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if not batch:
+                continue
+            t0 = time.perf_counter()
+            depth = self._q.qsize()
+            shed = self.admission.overloaded(depth)
+            bucket = (self.engine.buckets[-1] if shed
+                      else self.engine.bucket_for(len(batch)))
+            try:
+                padded = self.engine.pad_to_bucket(
+                    np.stack([r.image for r in batch]), bucket)
+                out = self.engine.run(bucket, padded)
+            except BaseException as exc:  # noqa: BLE001 - to the futures
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+                continue
+            now = time.perf_counter()
+            shared = _SharedBatch(out)
+            for i, r in enumerate(batch):
+                # hand each request its row of the shared device batch —
+                # no sync here; the first result() call materializes once
+                r.future.set_result((shared, i))
+                self.telemetry.record_dispatch_latency(now - r.t_submit)
+            self.telemetry.record_batch(bucket, len(batch),
+                                        self._q.qsize(), shed)
+            self.admission.note_drained(len(batch), now - t0)

@@ -1,0 +1,302 @@
+"""Serving engine (deeplearning_tpu/serve): bucket selection, AOT
+compile counters, batched-vs-unbatched bitwise parity (classification
+AND detection), micro-batcher demux, backpressure/deadline semantics,
+overload shedding, and the loadgen speedup gate.
+
+The parity tests are the PR's core contract: a request must get the
+SAME bits whether it rode a padded batch or ran alone, with zero XLA
+compiles after warmup (trace_count/compile_count are the test surface —
+the traced forward bumps trace_count exactly when XLA retraces)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from deeplearning_tpu.serve import (AdmissionController, DeadlineExceeded,
+                                    InferenceEngine, MicroBatcher,
+                                    Rejected, ServeTelemetry)
+
+
+def tree_equal(a, b):
+    import jax
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+@pytest.fixture(scope="module")
+def fcn_engine():
+    """One warmed classification session shared by the module (warmup
+    compiles exactly len(buckets) executables — reused so the suite
+    pays it once)."""
+    return InferenceEngine("mnist_fcn", num_classes=10, image_size=28,
+                           batch_buckets=(1, 4, 8))
+
+
+# --------------------------------------------------------------- buckets
+def test_bucket_selection():
+    eng = InferenceEngine("mnist_fcn", num_classes=10, image_size=28,
+                          batch_buckets=(8, 1, 32), precompile=False)
+    assert eng.buckets == (1, 8, 32)       # sorted, deduped
+    assert eng.bucket_for(1) == 1
+    assert eng.bucket_for(2) == 8
+    assert eng.bucket_for(8) == 8
+    assert eng.bucket_for(9) == 32
+    assert eng.bucket_for(33) == 32        # oversize: callers chunk
+    spec = eng.bucket_spec(8)
+    assert spec.shape == (8, 28, 28, 3)
+    with pytest.raises(ValueError):
+        InferenceEngine("mnist_fcn", num_classes=10,
+                        batch_buckets=(0, 4), precompile=False)
+
+
+def test_pad_to_bucket(fcn_engine):
+    imgs = np.ones((3, 28, 28, 3), np.float32)
+    padded = fcn_engine.pad_to_bucket(imgs, 8)
+    assert padded.shape == (8, 28, 28, 3)
+    assert np.array_equal(padded[:3], imgs)
+    assert not padded[3:].any()
+    assert fcn_engine.pad_to_bucket(imgs, 3) is imgs   # exact fit: no copy
+
+
+# ------------------------------------------------- compile-once contract
+def test_at_most_one_compile_per_bucket(fcn_engine):
+    eng = fcn_engine
+    assert eng.compile_count == len(eng.buckets)
+    assert eng.trace_count == len(eng.buckets)
+    eng.warmup()                            # idempotent
+    # concurrent callers race the compile lock: still one per bucket
+    threads = [threading.Thread(target=eng._compile_bucket, args=(b,))
+               for b in eng.buckets for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for n in (1, 3, 4, 7, 8):
+        eng.infer(np.zeros((n, 28, 28, 3), np.float32))
+    assert eng.compile_count == len(eng.buckets)
+    assert eng.trace_count == len(eng.buckets)
+    with pytest.raises(ValueError):
+        eng.run(5, np.zeros((5, 28, 28, 3), np.float32))  # not a bucket
+
+
+# ------------------------------------------------------- bitwise parity
+def test_classification_batch_parity_bitwise(fcn_engine):
+    eng = fcn_engine
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(7, 28, 28, 3)).astype(np.float32)
+    batched = eng.infer(images)             # pads 7 -> bucket 8
+    singles = np.stack([eng.infer(images[i])[0] for i in range(7)])
+    assert batched.shape == (7, 10)
+    assert np.array_equal(batched, singles)
+    assert eng.trace_count == len(eng.buckets)
+
+
+def test_detection_batch_parity_bitwise():
+    eng = InferenceEngine("retinanet_resnet18_fpn", num_classes=3,
+                          image_size=64, batch_buckets=(1, 4),
+                          score_thresh=0.05, max_det=10)
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(3, 64, 64, 3)).astype(np.float32)
+    batched = eng.infer(images)             # pads 3 -> bucket 4
+    for k in ("boxes", "scores", "labels", "valid"):
+        assert k in batched
+    assert batched["boxes"].shape == (3, 10, 4)
+    for i in range(3):
+        single = eng.infer(images[i])
+        assert tree_equal(
+            {k: v[i] for k, v in batched.items()},
+            {k: v[0] for k, v in single.items()})
+    # padded slots carry the class -1 convention, real rows never do
+    assert (np.asarray(batched["labels"])[
+        ~np.asarray(batched["valid"], bool)] == -1).all()
+    assert eng.trace_count == len(eng.buckets)
+    assert eng.compile_count == len(eng.buckets)
+
+
+def test_microbatcher_demux_parity(fcn_engine):
+    eng = fcn_engine
+    rng = np.random.default_rng(2)
+    images = rng.normal(size=(6, 28, 28, 3)).astype(np.float32)
+    direct = eng.infer(images)
+    with MicroBatcher(eng, max_wait_ms=20.0) as mb:
+        handles = [mb.submit(img) for img in images]
+        rows = [h.result(timeout=10.0) for h in handles]
+    assert np.array_equal(np.stack(rows), direct)
+    assert eng.trace_count == len(eng.buckets)
+    snap = mb.telemetry.snapshot()
+    assert snap["submitted"] == 6 and snap["completed"] == 6
+    assert snap["batches"] >= 1
+
+
+# ----------------------------------------- admission policy (pure logic)
+def test_admission_backpressure_and_bucket_policy():
+    adm = AdmissionController((1, 4, 16), max_queue=3)
+    adm.admit(2)                            # has room
+    with pytest.raises(Rejected) as ei:
+        adm.admit(3)
+    assert ei.value.retry_after_s > 0
+    adm.note_drained(16, 0.1)               # 160 req/s observed
+    assert 1e-3 <= adm.retry_after_s(8) <= 30.0
+    assert adm.target_bucket(0) == 1
+    assert adm.target_bucket(3) == 4
+    assert adm.target_bucket(100) == 16     # overload: largest only
+    assert adm.overloaded(16) and not adm.overloaded(15)
+    assert adm.expired(None) is False
+    now = time.perf_counter()
+    assert adm.expired(now - 1.0)
+    assert not adm.expired(now + 60.0)
+    assert adm.deadline_for(None) is None   # no default timeout
+    assert adm.deadline_for(1.0, now=10.0) == 11.0
+
+
+class _SlowFakeEngine:
+    """Controllable engine stub: the batcher contract is just buckets /
+    bucket_for / pad_to_bucket / run / image_size, so saturation tests
+    need no XLA (run blocks until released, deterministically)."""
+
+    def __init__(self, buckets=(1, 2, 8), size=4):
+        self.buckets = tuple(sorted(buckets))
+        self.image_size = size
+        self.release = threading.Event()
+        self.ran_buckets = []
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def pad_to_bucket(self, images, bucket):
+        n = images.shape[0]
+        if n == bucket:
+            return images
+        pad = np.zeros((bucket - n, *images.shape[1:]), images.dtype)
+        return np.concatenate([images, pad], axis=0)
+
+    def run(self, bucket, images):
+        self.release.wait(timeout=10.0)
+        self.ran_buckets.append(bucket)
+        return images.sum(axis=(1, 2, 3))   # one scalar per row
+
+
+def test_backpressure_on_saturated_queue():
+    eng = _SlowFakeEngine()
+    img = np.ones((4, 4, 3), np.float32)
+    with MicroBatcher(eng, max_wait_ms=1.0, max_queue=2) as mb:
+        first = mb.submit(img)              # dispatcher blocks in run()
+        time.sleep(0.1)                     # let it pop the first request
+        held = [mb.submit(img), mb.submit(img)]   # fills max_queue=2
+        with pytest.raises(Rejected) as ei:
+            mb.submit(img)
+        assert ei.value.retry_after_s > 0
+        eng.release.set()                   # drain
+        assert first.result(timeout=10.0) == pytest.approx(48.0)
+        for h in held:
+            h.result(timeout=10.0)
+    assert mb.telemetry.snapshot()["rejected"] == 1
+
+
+def test_deadline_cancels_before_dispatch():
+    eng = _SlowFakeEngine()
+    img = np.ones((4, 4, 3), np.float32)
+    with MicroBatcher(eng, max_wait_ms=1.0) as mb:
+        blocker = mb.submit(img)            # occupies the dispatcher
+        time.sleep(0.1)
+        doomed = mb.submit(img, timeout_s=0.01)   # expires in queue
+        time.sleep(0.1)
+        eng.release.set()
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10.0)
+        blocker.result(timeout=10.0)
+    snap = mb.telemetry.snapshot()
+    assert snap["timed_out"] == 1
+    # the expired request never reached the engine: only real dispatches
+    assert sum(eng.ran_buckets) == sum(
+        eng.bucket_for(1) for _ in range(len(eng.ran_buckets)))
+
+
+def test_overload_sheds_to_largest_bucket():
+    eng = _SlowFakeEngine(buckets=(1, 2, 8))
+    eng.release.set()                       # run() returns immediately
+    img = np.ones((4, 4, 3), np.float32)
+    adm = AdmissionController(eng.buckets, max_queue=64, shed_threshold=1)
+    mb = MicroBatcher(eng, max_wait_ms=0.0, admission=adm, start=False)
+    handles = [mb.submit(img) for _ in range(4)]   # queue builds unstarted
+    mb.start()
+    for h in handles:
+        h.result(timeout=10.0)
+    mb.close()
+    # max_wait 0 pops single requests, but the deep queue trips the shed
+    # policy: at least one dispatch ran in the LARGEST bucket
+    assert 8 in eng.ran_buckets
+    assert mb.telemetry.snapshot()["shed_batches"] >= 1
+
+
+def test_telemetry_percentiles():
+    t = ServeTelemetry()
+    for ms in range(1, 101):
+        t.record_e2e_latency(ms / 1e3)
+    lat = t.latency_ms("e2e")
+    assert lat["p50"] == pytest.approx(51.0)   # nearest-rank: xs[50]
+    assert lat["p99"] == pytest.approx(100.0)  # xs[99]
+    t.record_batch(8, 6, queue_depth=2, shed=False)
+    assert t.batch_occupancy == pytest.approx(0.75)
+    snap = t.snapshot()
+    assert snap["batches"] == 1 and snap["queue_depth_mean"] == 2.0
+
+
+# ------------------------------------------------------- loadgen gate
+def test_loadgen_dynamic_batching_speedup(fcn_engine):
+    """The PR acceptance gate: closed-loop dynamic batching beats the
+    sequential per-request baseline >=3x at 64 concurrent clients on
+    CPU (measured ~25x for the dispatch-dominated mnist_fcn; 3x leaves
+    an 8x margin for machine noise)."""
+    from loadgen import make_images, run_closed_loop, run_sequential
+    eng = fcn_engine
+    images = make_images(8, 28)
+    seq = run_sequential(eng, images, 192)
+    with MicroBatcher(eng, max_wait_ms=5.0) as mb:
+        closed = run_closed_loop(mb, images, concurrency=64,
+                                 n_requests=192)
+    assert closed["completed"] == 192
+    speedup = closed["req_per_s"] / max(seq["req_per_s"], 1e-9)
+    assert speedup >= 3.0, f"dynamic batching only {speedup:.2f}x"
+    assert closed["batch_occupancy"] > 0.2
+    assert eng.trace_count == len(eng.buckets)
+
+
+def test_hub_serve_entry_point():
+    from deeplearning_tpu import hub
+    eng = hub.serve("mnist_fcn", num_classes=10, image_size=28,
+                    batch_buckets=(1, 2))
+    assert isinstance(eng, InferenceEngine)
+    assert eng.compile_count == 2           # warmed at construction
+    out = eng.infer(np.zeros((1, 28, 28, 3), np.float32))
+    assert out.shape == (1, 10)
+    assert eng.compile_count == 2
+
+
+# --------------------------------------------------- predict.py client
+def test_predict_npz_multi_image(tmp_path, capsys):
+    import predict
+    rng = np.random.default_rng(3)
+    npz = tmp_path / "batch.npz"
+    np.savez(npz, images=rng.normal(size=(3, 28, 28, 3)).astype(np.float32))
+    rc = predict.main(["--model", "mnist_fcn", "--num-classes", "4",
+                       "--input", str(npz), "--topk", "2"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 3                  # one line PER image
+    for i, line in enumerate(lines):
+        assert line.startswith(f"image {i}: ")
+        assert len(line.split("=")) == 3    # topk=2 -> two probabilities

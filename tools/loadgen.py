@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Load generator for the serving engine (closed- and open-loop).
+
+  # 64 closed-loop clients against a dynamically-batched model
+  python tools/loadgen.py --model mnist_fcn --num-classes 10 --size 28 \\
+      --buckets 1,8,64 --mode compare --concurrency 64 --n 512
+
+Modes:
+- ``closed``: N concurrent clients, each submitting back-to-back
+  (throughput under saturation — the MLPerf-server closed loop).
+- ``open``: fixed-rate arrivals regardless of completions (latency under
+  a target QPS; finds the knee where admission control kicks in).
+- ``sequential``: one-at-a-time ``engine.infer`` — the predict.py-style
+  baseline dynamic batching is measured against.
+- ``compare``: sequential then closed, printing the speedup (the serve
+  acceptance gate: batched ≥3× sequential at 64 clients on CPU).
+
+Every run can append a ``--set serve`` row (op schema:
+``bench_util.append_op_result``) to tools/mfu_results.jsonl so the
+request-path latency trajectory is recorded next to the train-step MFU
+rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import numpy as np
+
+
+def _percentiles_ms(lats):
+    if not lats:
+        return {"p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0}
+    p50, p90, p99 = (float(v) for v in
+                     np.percentile(np.asarray(lats), [50, 90, 99]))
+    return {"p50_ms": round(p50 * 1e3, 3), "p90_ms": round(p90 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3)}
+
+
+def make_images(n: int, size: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).normal(
+        size=(n, size, size, 3)).astype(np.float32)
+
+
+def run_sequential(engine, images, n_requests: int) -> dict:
+    """Unbatched baseline: requests served one at a time, each paying a
+    full dispatch + materialize round-trip (tools/predict.py's shape)."""
+    lats = []
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        t1 = time.perf_counter()
+        engine.infer(images[i % len(images)])
+        lats.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    return {"mode": "sequential", "completed": n_requests, "rejected": 0,
+            "timed_out": 0, "req_per_s": round(n_requests / wall, 1),
+            "wall_s": round(wall, 3), **_percentiles_ms(lats)}
+
+
+def run_closed_loop(batcher, images, concurrency: int, n_requests: int,
+                    timeout_s: float = 30.0) -> dict:
+    """``concurrency`` clients, each submit→materialize back-to-back
+    until ``n_requests`` total complete. Backpressure rejections honor
+    the retry-after hint (bounded, so a saturated queue slows clients
+    down instead of losing work)."""
+    from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+
+    lock = threading.Lock()
+    state = {"launched": 0, "completed": 0, "rejected": 0, "timed_out": 0}
+    lats = []
+
+    def worker(wid: int):
+        rng = np.random.default_rng(wid)
+        while True:
+            with lock:
+                if state["launched"] >= n_requests:
+                    return
+                state["launched"] += 1
+            img = images[int(rng.integers(len(images)))]
+            t0 = time.perf_counter()
+            try:
+                handle = batcher.submit(img)
+                handle.result(timeout=timeout_s)
+            except Rejected as r:
+                with lock:
+                    state["rejected"] += 1
+                time.sleep(min(r.retry_after_s, 0.2))
+                continue
+            except DeadlineExceeded:
+                with lock:
+                    state["timed_out"] += 1
+                continue
+            with lock:
+                state["completed"] += 1
+                lats.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = batcher.telemetry.snapshot()
+    return {"mode": "closed", "concurrency": concurrency, **state,
+            "req_per_s": round(state["completed"] / wall, 1),
+            "wall_s": round(wall, 3), **_percentiles_ms(lats),
+            "batch_occupancy": snap["batch_occupancy"],
+            "queue_depth_mean": snap["queue_depth_mean"],
+            "shed_batches": snap["shed_batches"]}
+
+
+def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
+                  timeout_s: float = 10.0) -> dict:
+    """Fixed-rate arrivals: one submitter paces requests at ``rate_hz``;
+    a resolver pool materializes results. Rejections are counted and
+    DROPPED (open-loop semantics — the arrival process never waits)."""
+    import queue as _queue
+
+    from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+
+    handles: "_queue.Queue" = _queue.Queue()
+    lock = threading.Lock()
+    state = {"submitted": 0, "completed": 0, "rejected": 0,
+             "timed_out": 0}
+    lats = []
+    done = threading.Event()
+
+    def resolver():
+        while True:
+            item = handles.get()
+            if item is None:
+                return
+            t0, handle = item
+            try:
+                handle.result(timeout=timeout_s)
+            except (DeadlineExceeded, Exception):  # noqa: BLE001
+                with lock:
+                    state["timed_out"] += 1
+                continue
+            with lock:
+                state["completed"] += 1
+                lats.append(time.perf_counter() - t0)
+
+    pool = [threading.Thread(target=resolver, daemon=True)
+            for _ in range(8)]
+    for t in pool:
+        t.start()
+    period = 1.0 / rate_hz
+    rng = np.random.default_rng(0)
+    t_end = time.perf_counter() + duration_s
+    next_t = time.perf_counter()
+    while time.perf_counter() < t_end:
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(next_t - now)
+        next_t += period
+        img = images[int(rng.integers(len(images)))]
+        t0 = time.perf_counter()
+        try:
+            handle = batcher.submit(img)
+        except Rejected:
+            with lock:
+                state["rejected"] += 1
+            continue
+        with lock:
+            state["submitted"] += 1
+        handles.put((t0, handle))
+    for _ in pool:
+        handles.put(None)
+    for t in pool:
+        t.join(timeout=timeout_s)
+    done.set()
+    snap = batcher.telemetry.snapshot()
+    return {"mode": "open", "rate_hz": rate_hz, **state,
+            "req_per_s": round(state["completed"] / duration_s, 1),
+            **_percentiles_ms(lats),
+            "batch_occupancy": snap["batch_occupancy"],
+            "queue_depth_mean": snap["queue_depth_mean"],
+            "shed_batches": snap["shed_batches"]}
+
+
+def append_serve_row(results_path: str, rec: dict, **extra) -> None:
+    """One serve row in the shared op-row schema (``"op" in rec`` splits
+    op rows from step rows for every mfu_results.jsonl consumer)."""
+    from bench_util import append_op_result
+    tag = rec.get("concurrency", rec.get("rate_hz", 1))
+    append_op_result(
+        results_path, f"serve_{rec['mode']}", n=int(tag),
+        ms=rec.get("p50_ms", 0.0), req_per_s=rec.get("req_per_s", 0.0),
+        p99_ms=rec.get("p99_ms", 0.0), completed=rec.get("completed", 0),
+        rejected=rec.get("rejected", 0),
+        batch_occupancy=rec.get("batch_occupancy", 0.0), **extra)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    # default: a model whose single-request cost is dispatch-dominated,
+    # so the compare mode isolates the batching win (a conv model's CPU
+    # compute scales linearly with batch and hides the amortization)
+    ap.add_argument("--model", default="mnist_fcn")
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--size", type=int, default=28)
+    ap.add_argument("--buckets", default="1,8,64",
+                    help="comma-separated batch buckets")
+    ap.add_argument("--mode", default="compare",
+                    choices=["closed", "open", "sequential", "compare"])
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--n", type=int, default=512,
+                    help="total requests (closed/sequential)")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop arrivals per second")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="open-loop duration seconds")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--timeout-s", type=float, default=None,
+                    help="per-request deadline")
+    ap.add_argument("--results", default=None,
+                    help="append serve rows to this jsonl "
+                         "(default: tools/mfu_results.jsonl; 'none' off)")
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = InferenceEngine(
+        args.model, num_classes=args.num_classes, ckpt=args.ckpt,
+        image_size=args.size, batch_buckets=buckets)
+    images = make_images(max(buckets[-1], 64), args.size)
+    results_path = args.results or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "mfu_results.jsonl")
+    write_rows = (args.results or "").lower() != "none"
+
+    def report(rec, **extra):
+        print(json.dumps(rec), flush=True)
+        if write_rows:
+            append_serve_row(results_path, rec, model=args.model,
+                             **extra)
+
+    recs = []
+    if args.mode in ("sequential", "compare"):
+        rec = run_sequential(engine, images, args.n)
+        report(rec)
+        recs.append(rec)
+    if args.mode in ("closed", "compare"):
+        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+                          max_queue=args.max_queue,
+                          default_timeout_s=args.timeout_s) as mb:
+            rec = run_closed_loop(mb, images, args.concurrency, args.n)
+        report(rec)
+        recs.append(rec)
+    if args.mode == "open":
+        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+                          max_queue=args.max_queue,
+                          default_timeout_s=args.timeout_s) as mb:
+            rec = run_open_loop(mb, images, args.rate, args.duration)
+        report(rec)
+        recs.append(rec)
+    if args.mode == "compare" and len(recs) == 2:
+        speedup = recs[1]["req_per_s"] / max(recs[0]["req_per_s"], 1e-9)
+        print(json.dumps({"mode": "compare",
+                          "speedup_vs_sequential": round(speedup, 2)}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

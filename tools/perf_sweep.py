@@ -278,9 +278,38 @@ def time_detect_set(results_path=None):
                 append_op_result(results_path, f"roi_align_{impl}",
                                  n=r, ms=ms)
 
+    # Faster R-CNN second stage A/B (ROADMAP PR 3 follow-up): the SAME
+    # jitted two-stage predict path, swapping only the model's
+    # roi_align_impl knob — the row pair attributes the second-stage
+    # cost to the one-pass packed gather vs the masked reference
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.models.detection.predict import build_predict_fn
+    rcnn_img, rcnn_batch = 256, 2
+    rcnn_images = jnp.asarray(rng.normal(
+        size=(rcnn_batch, rcnn_img, rcnn_img, 3)).astype(np.float32))
+    for impl in ("onepass", "masked"):
+        model = MODELS.build("fasterrcnn_resnet18_fpn", num_classes=4,
+                             roi_align_impl=impl)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, rcnn_img, rcnn_img, 3)),
+                               train=False)
+        predict = build_predict_fn(model, "fasterrcnn_resnet18_fpn", 3,
+                                   score_thresh=0.05, max_det=100)
+        fn = jax.jit(functools.partial(
+            predict, variables["params"],
+            variables.get("batch_stats", {})))
+        dt = bench(fn, (rcnn_images,), n=10)
+        print(f"fasterrcnn_roi_{impl:8s} batch={rcnn_batch} "
+              f"{dt * 1e3:9.2f} ms", flush=True)
+        if results_path:
+            append_result(results_path, f"fasterrcnn_roi_{impl}",
+                          batch=rcnn_batch, step_ms=dt * 1e3,
+                          img_per_s=rcnn_batch / dt, mfu_pct=0.0,
+                          model="fasterrcnn_resnet18_fpn",
+                          image_size=rcnn_img, roi_align_impl=impl)
+
     # end-to-end eval path: the per-step unit of evaluation/coco_eval —
     # one jitted forward + postprocess over a padded batch
-    from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.models.detection.retinanet import (
         retinanet_anchors, retinanet_postprocess)
     img, batch = 512, 8
@@ -306,11 +335,60 @@ def time_detect_set(results_path=None):
                       model="retinanet_resnet18_fpn", image_size=img)
 
 
+def time_serve_set(results_path=None):
+    """Serving-path sweep (serve/ + tools/loadgen.py): the sequential
+    per-request baseline vs dynamic micro-batching at several
+    concurrencies, on a dispatch-dominated model so the rows isolate the
+    batching win rather than raw conv throughput. On TPU, adds a
+    ViT-B/16 closed-loop row — the bucket-calibration input for the
+    ROADMAP follow-up."""
+    from loadgen import (append_serve_row, make_images, run_closed_loop,
+                         run_sequential)
+
+    from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+
+    engine = InferenceEngine("mnist_fcn", num_classes=10, image_size=28,
+                             batch_buckets=(1, 8, 64))
+    images = make_images(64, 28)
+    rec = run_sequential(engine, images, 256)
+    print(f"serve_sequential          {rec['req_per_s']:8.1f} req/s "
+          f"p99={rec['p99_ms']:7.2f} ms", flush=True)
+    if results_path:
+        append_serve_row(results_path, rec, model="mnist_fcn")
+    base = rec["req_per_s"]
+    for conc in (8, 64):
+        with MicroBatcher(engine, max_wait_ms=5.0) as mb:
+            rec = run_closed_loop(mb, images, conc, 256)
+        print(f"serve_closed  conc={conc:4d} {rec['req_per_s']:8.1f} "
+              f"req/s p99={rec['p99_ms']:7.2f} ms "
+              f"occ={rec['batch_occupancy']:.2f} "
+              f"x{rec['req_per_s'] / max(base, 1e-9):.2f}", flush=True)
+        if results_path:
+            append_serve_row(results_path, rec, model="mnist_fcn",
+                             speedup=round(rec["req_per_s"]
+                                           / max(base, 1e-9), 2))
+
+    if jax.default_backend() == "tpu":
+        # on-chip row: the model the repo actually trains, served at its
+        # natural buckets — feeds the v4 bucket-calibration follow-up
+        engine = InferenceEngine("vit_base_patch16_224", num_classes=1000,
+                                 image_size=224,
+                                 batch_buckets=(1, 8, 32))
+        images = make_images(32, 224)
+        with MicroBatcher(engine, max_wait_ms=5.0) as mb:
+            rec = run_closed_loop(mb, images, 32, 128)
+        print(f"serve_closed_vit conc=32 {rec['req_per_s']:8.1f} req/s "
+              f"p99={rec['p99_ms']:7.2f} ms", flush=True)
+        if results_path:
+            append_serve_row(results_path, rec,
+                             model="vit_base_patch16_224")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
                     choices=["batch", "attn", "all", "r5", "decomp",
-                             "feed", "detect"])
+                             "feed", "detect", "serve"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -338,6 +416,8 @@ def main():
             time_variant("patch_conv_b128", 128, results_path=results)
     if args.set == "detect":
         time_detect_set(results_path=results)
+    if args.set == "serve":
+        time_serve_set(results_path=results)
     if args.set == "feed":
         # feed-side A/B for the MFU claim: serial blocking H2D vs the
         # threaded prefetch pipeline, same step, real per-iter batches

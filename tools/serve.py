@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Inference server CLI over the serving engine.
+
+  # stdin mode: one image path per line, one JSON answer per line
+  echo img.png | python tools/serve.py --model mnist_cnn \\
+      --num-classes 10 --size 28 [--ckpt DIR]
+
+  # optional HTTP mode (stdlib-only): POST /predict with an .npy body
+  python tools/serve.py --model yolox_tiny --num-classes 80 \\
+      --size 416 --http 8000
+
+Every request path — stdin lines, HTTP posts, .npz batches — goes
+through the same ``MicroBatcher.submit()`` front door, so concurrent
+clients batch together, admission control applies (full queue answers
+"rejected" with a retry-after hint instead of queueing unboundedly),
+and the model only ever executes its warmed bucket shapes. ``GET
+/stats`` (HTTP) or EOF (stdin) reports the telemetry snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLTPU_PLATFORM"])
+
+import numpy as np
+
+
+def load_request_images(path: str, size: int, task: str) -> np.ndarray:
+    """One request's model-ready (n, size, size, 3) frames.
+
+    Conventions shared with predict.py/demo.py: ``.npz`` batches are
+    model-ready (tools/train.py feeds npz raw — normalizing again would
+    double-normalize); image files go through the classification eval
+    transform, or plain resize+/255 for detection (demo.py's frame)."""
+    from deeplearning_tpu.data.datasets import load_image
+    if path.endswith(".npz"):
+        imgs = np.load(path)["images"]
+    else:
+        raw = np.asarray(load_image(path), np.float32)
+        if task == "detect":
+            if not path.lower().endswith(".npy"):
+                raw = raw / 255.0      # .npy is model-ready by convention
+            import jax.numpy as jnp
+            imgs = np.asarray(jax.image.resize(
+                jnp.asarray(raw), (size, size, 3), "bilinear"))[None]
+            return imgs.astype(np.float32)
+        else:
+            from deeplearning_tpu.data.transforms import (
+                classification_eval_transform)
+            fn = classification_eval_transform((size, size))
+            imgs = fn({"image": raw[None]})["image"]
+    imgs = np.asarray(imgs, np.float32)
+    if imgs.ndim == 3:
+        imgs = imgs[None]
+    if imgs.shape[1:3] != (size, size):
+        import jax.numpy as jnp
+        imgs = np.asarray(jax.image.resize(
+            jnp.asarray(imgs), (imgs.shape[0], size, size, 3),
+            "bilinear"))
+    return imgs
+
+
+def format_answer(task: str, row, names, topk: int) -> dict:
+    """One request's JSON answer. Detection answers carry only the
+    VALID rows — the fixed-shape class −1 padding slots never leave the
+    server."""
+    if task == "classify":
+        order = np.argsort(-row)[:topk]
+        return {"top": [[names.get(int(i), int(i)), round(float(row[i]), 4)]
+                        for i in order]}
+    keep = np.asarray(row["valid"], bool)
+    return {"detections": [
+        {"box": [round(float(x), 1) for x in b],
+         "score": round(float(s), 4),
+         "label": names.get(int(c), int(c))}
+        for b, s, c in zip(np.asarray(row["boxes"])[keep],
+                           np.asarray(row["scores"])[keep],
+                           np.asarray(row["labels"])[keep])]}
+
+
+def serve_stdin(batcher, task: str, size: int, names, topk: int,
+                timeout_s: float, stream_in=None, stream_out=None) -> int:
+    """Line protocol: path in, JSON out (one line per image; an .npz
+    submits every row concurrently so they micro-batch together)."""
+    from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+    stream_in = stream_in or sys.stdin
+    stream_out = stream_out or sys.stdout
+    for line in stream_in:
+        path = line.strip()
+        if not path:
+            continue
+        try:
+            images = load_request_images(path, size, task)
+            handles = [batcher.submit(img) for img in images]
+        except Rejected as r:
+            print(json.dumps({"error": "rejected", "path": path,
+                              "retry_after_s": round(r.retry_after_s, 3)}),
+                  file=stream_out, flush=True)
+            continue
+        except Exception as e:  # noqa: BLE001 - per-line protocol
+            print(json.dumps({"error": repr(e), "path": path}),
+                  file=stream_out, flush=True)
+            continue
+        for i, h in enumerate(handles):
+            try:
+                row = h.result(timeout=timeout_s)
+                ans = format_answer(task, row, names, topk)
+            except DeadlineExceeded:
+                ans = {"error": "deadline_exceeded"}
+            ans.update({"path": path, "image": i})
+            print(json.dumps(ans), file=stream_out, flush=True)
+    print(json.dumps(batcher.telemetry.snapshot()), file=sys.stderr,
+          flush=True)
+    return 0
+
+
+def serve_http(batcher, task: str, size: int, names, topk: int,
+               timeout_s: float, port: int):
+    """Minimal stdlib HTTP front: POST /predict (.npy body, one image or
+    a batch) → JSON; GET /stats → telemetry. ThreadingHTTPServer gives
+    each request its own thread, so concurrent posts micro-batch."""
+    import io
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):   # quiet: telemetry is the log
+            pass
+
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/stats":
+                return self._json(200, batcher.telemetry.snapshot())
+            return self._json(404, {"error": "GET /stats only"})
+
+        def do_POST(self):
+            if self.path.rstrip("/") != "/predict":
+                return self._json(404, {"error": "POST /predict only"})
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                arr = np.load(io.BytesIO(self.rfile.read(n)),
+                              allow_pickle=False)
+                images = np.asarray(arr, np.float32)
+                if images.ndim == 3:
+                    images = images[None]
+                handles = [batcher.submit(img) for img in images]
+                rows = [h.result(timeout=timeout_s) for h in handles]
+            except Rejected as r:
+                self.send_response_only(429)
+                self.send_header("Retry-After",
+                                 f"{r.retry_after_s:.3f}")
+                self.end_headers()
+                return None
+            except DeadlineExceeded:
+                return self._json(504, {"error": "deadline_exceeded"})
+            except Exception as e:  # noqa: BLE001 - request-scoped
+                return self._json(400, {"error": repr(e)})
+            return self._json(200, {"results": [
+                format_answer(task, row, names, topk) for row in rows]})
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(json.dumps({"serving": f"http://127.0.0.1:{server.server_port}",
+                      "endpoints": ["/predict", "/stats"]}), flush=True)
+    return server
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--buckets", default="1,8,32",
+                    help="comma-separated batch buckets")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--timeout-s", type=float, default=30.0,
+                    help="per-request deadline")
+    ap.add_argument("--topk", type=int, default=5)
+    ap.add_argument("--score", type=float, default=0.3,
+                    help="detection score threshold")
+    ap.add_argument("--max-det", type=int, default=100)
+    ap.add_argument("--nms-impl", default="auto")
+    ap.add_argument("--tta", action="store_true",
+                    help="classification flip-TTA inside the executable")
+    ap.add_argument("--classes", default=None,
+                    help="json mapping class index -> name")
+    ap.add_argument("--http", type=int, default=None,
+                    help="serve HTTP on this port instead of stdin "
+                         "(0 = ephemeral)")
+    args = ap.parse_args(argv)
+
+    from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+
+    engine = InferenceEngine(
+        args.model, num_classes=args.num_classes, ckpt=args.ckpt,
+        image_size=args.size,
+        batch_buckets=tuple(int(b) for b in args.buckets.split(",")),
+        tta=args.tta, score_thresh=args.score, max_det=args.max_det,
+        nms_impl=args.nms_impl)
+    print(json.dumps({"ready": engine.stats()}), file=sys.stderr,
+          flush=True)
+    names = {}
+    if args.classes:
+        with open(args.classes) as f:
+            names = {int(k): v for k, v in json.load(f).items()}
+
+    with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+                      max_queue=args.max_queue,
+                      default_timeout_s=args.timeout_s) as batcher:
+        if args.http is not None:
+            server = serve_http(batcher, engine.task, args.size, names,
+                                args.topk, args.timeout_s, args.http)
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.server_close()
+            return 0
+        return serve_stdin(batcher, engine.task, args.size, names,
+                           args.topk, args.timeout_s)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -134,18 +134,26 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
     The image size is read from the traced batch shape, so grids/anchors
     are rebuilt per multi-scale bucket. ``rcnn_kw``: fasterrcnn sizing
     (post_nms_top_n, roi_batch). ``nms_impl`` selects the suppression
-    path for every family's postprocess (ops/nms.py)."""
+    path for every family's postprocess (ops/nms.py).
+
+    The predict half delegates to
+    ``models/detection/predict.build_predict_fn`` — the one shared
+    definition of each family's postprocessed forward, so training eval
+    and the serving engine decode identically."""
+    from deeplearning_tpu.models.detection.predict import build_predict_fn
     rcnn_kw = rcnn_kw or {}
+    predict_fn = build_predict_fn(
+        model, name, num_classes, score_thresh=score_thresh,
+        max_det=max_det,
+        post_nms_top_n=rcnn_kw.get("post_nms_top_n",
+                                   DetModelCfg.rcnn_post_nms_top_n),
+        nms_impl=nms_impl)
 
     def apply_train(params, stats, images, **kw):
         out, mut = model.apply({"params": params, "batch_stats": stats},
                                images, train=True,
                                mutable=["batch_stats"], **kw)
         return out, mut.get("batch_stats", stats)
-
-    def apply_eval(params, stats, images, **kw):
-        return model.apply({"params": params, "batch_stats": stats},
-                           images, train=False, **kw)
 
     if name.startswith("retinanet"):
         from deeplearning_tpu.models.detection.retinanet import (
@@ -159,17 +167,11 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
                                batch["valid"])
             return l["cls_loss"] + l["reg_loss"], new_stats
 
-        def predict_fn(params, stats, images):
-            hw = images.shape[1:3]
-            out = apply_eval(params, stats, images)
-            return retinanet_postprocess(
-                out, jnp.asarray(retinanet_anchors(hw)), hw, max_det=max_det,
-                score_thresh=score_thresh, nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("yolox"):
         from deeplearning_tpu.models.detection.yolox import (
-            yolox_grid, yolox_loss, yolox_postprocess)
+            yolox_grid, yolox_loss)
 
         def loss_fn(params, stats, batch, rng, use_l1=False):
             hw = batch["image"].shape[1:3]
@@ -181,18 +183,11 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             return (l["iou_loss"] + l["obj_loss"] + l["cls_loss"]
                     + l["l1_loss"], new_stats)
 
-        def predict_fn(params, stats, images):
-            hw = images.shape[1:3]
-            centers, strides = (jnp.asarray(a) for a in yolox_grid(hw))
-            out = apply_eval(params, stats, images)
-            return yolox_postprocess(out, centers, strides, max_det=max_det,
-                                     score_thresh=score_thresh,
-                                     nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("yolov5"):
         from deeplearning_tpu.models.detection.yolov5 import (
-            yolov5_grid, yolov5_loss, yolov5_postprocess)
+            yolov5_grid, yolov5_loss)
 
         def loss_fn(params, stats, batch, rng):
             hw = batch["image"].shape[1:3]
@@ -204,19 +199,11 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             return (l["box_loss"] + l["obj_loss"] + l["cls_loss"],
                     new_stats)
 
-        def predict_fn(params, stats, images):
-            hw = images.shape[1:3]
-            grid = {k: jnp.asarray(v)
-                    for k, v in yolov5_grid(hw).items()}
-            out = apply_eval(params, stats, images)
-            return yolov5_postprocess(out, grid, max_det=max_det,
-                                      score_thresh=score_thresh,
-                                      nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("fcos"):
         from deeplearning_tpu.models.detection.fcos import (
-            fcos_locations, fcos_loss, fcos_postprocess, fcos_targets)
+            fcos_locations, fcos_loss, fcos_targets)
 
         def loss_fn(params, stats, batch, rng):
             hw = batch["image"].shape[1:3]
@@ -228,14 +215,6 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             return (l["cls_loss"] + l["reg_loss"] + l["ctr_loss"],
                     new_stats)
 
-        def predict_fn(params, stats, images):
-            hw = images.shape[1:3]
-            locs, _ = fcos_locations(hw)
-            out = apply_eval(params, stats, images)
-            return fcos_postprocess(out, jnp.asarray(locs), hw,
-                                    max_det=max_det,
-                                    score_thresh=score_thresh,
-                                    nms_impl=nms_impl)
         return loss_fn, predict_fn
 
     if name.startswith("fasterrcnn"):
@@ -246,8 +225,8 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
         # num_classes+1 with 0 = background, so gt labels shift +1 here
         # and detections shift -1 back in predict.
         from deeplearning_tpu.models.detection.faster_rcnn import (
-            fasterrcnn_anchors, fasterrcnn_postprocess,
-            generate_proposals, roi_head_loss, rpn_loss, sample_rois)
+            fasterrcnn_anchors, generate_proposals, roi_head_loss,
+            rpn_loss, sample_rois)
         # fall back to the DetModelCfg defaults (single source of truth
         # for callers like demo.py that pass no rcnn_kw)
         post_nms = rcnn_kw.get("post_nms_top_n",
@@ -279,21 +258,6 @@ def build_task(model, name: str, num_classes: int, score_thresh: float,
             return (r["rpn_obj_loss"] + r["rpn_reg_loss"]
                     + h["roi_cls_loss"] + h["roi_reg_loss"], stats1)
 
-        def predict_fn(params, stats, images):
-            hw = images.shape[1:3]
-            anchors = jnp.asarray(fasterrcnn_anchors(hw))
-            out = apply_eval(params, stats, images)
-            props, pvalid = generate_proposals(out, anchors, hw,
-                                               post_nms_top_n=post_nms,
-                                               nms_impl=nms_impl)
-            out2 = apply_eval(params, stats, images, proposals=props,
-                              pyramid=out["pyramid"])
-            det = fasterrcnn_postprocess(
-                out2["roi_scores"], out2["roi_deltas"], props, hw,
-                prop_valid=pvalid, score_thresh=score_thresh, max_det=max_det,
-                nms_impl=nms_impl)
-            det["labels"] = det["labels"] - 1      # back to 0-based fg
-            return det
         return loss_fn, predict_fn
 
     raise ValueError(f"no detection task for model {name!r} "
