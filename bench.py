@@ -185,6 +185,50 @@ def _serve_smoke():
     }
 
 
+def _obs_smoke():
+    """Observability-overhead smoke on the host CPU: the same jitted
+    train step timed with span tracing off vs on (min-of-reps). Rides in
+    every bench record so a regression in the instrumentation cost —
+    the README policy is <2% of step time — shows up next to the MFU
+    number it would silently tax."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from bench_util import obs_overhead
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.train import TrainState, make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+        from deeplearning_tpu.train.optim import build_optimizer
+        from deeplearning_tpu.train.schedules import build_schedule
+
+        model = MODELS.build("mnist_fcn", num_classes=10)
+        rng = jax.random.key(0)
+        params = model.init(rng, jnp.zeros((1, 28, 28, 1)),
+                            train=False)["params"]
+        tx = build_optimizer(
+            "sgd", build_schedule("constant", base_lr=1e-2), params=params)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        data = {
+            "image": jnp.asarray(np.random.default_rng(0).normal(
+                size=(64, 28, 28, 1)), jnp.float32),
+            "label": jnp.asarray(np.random.default_rng(1).integers(
+                0, 10, 64), jnp.int32),
+        }
+        step = jax.jit(make_train_step(make_loss_fn()))
+
+        def one_step(s, b, r):
+            _, m = step(s, b, r)
+            return m["loss"]
+
+        res = obs_overhead(one_step, (state, data, rng), n=50, reps=3)
+    res["backend"] = "cpu"
+    return res
+
+
 def _health_probe():
     """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
     must complete within _PROBE_DEADLINE_S, else report and exit instead of
@@ -208,6 +252,10 @@ def _health_probe():
                 cpu_fallback["serve"] = _serve_smoke()
             except Exception as e:  # noqa: BLE001 - fallback best-effort
                 cpu_fallback["serve"] = {"error": repr(e)}
+            try:
+                cpu_fallback["obs"] = _obs_smoke()
+            except Exception as e:  # noqa: BLE001 - fallback best-effort
+                cpu_fallback["obs"] = {"error": repr(e)}
             print(json.dumps({
                 "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
                 "vs_baseline": 0.0, "error": "health probe timeout: device "
@@ -318,6 +366,12 @@ def main():
         rec["serve"] = _serve_smoke()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["serve"] = {"error": repr(e)}
+    try:
+        # instrumentation-cost smoke: span-on vs span-off step time must
+        # stay within the README policy budget (<2%)
+        rec["obs"] = _obs_smoke()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["obs"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

@@ -21,35 +21,58 @@ import jax
 import numpy as np
 
 _LOGGERS: Dict[str, logging.Logger] = {}
+# output dirs a cached logger already writes to — a cache hit with a NEW
+# dir attaches its file handler instead of silently dropping the dir
+# (the old behavior lost the second run's log file entirely)
+_LOGGER_DIRS: Dict[str, set] = {}
 
 
 def is_main_process() -> bool:
     return jax.process_index() == 0
 
 
+def _fmt() -> logging.Formatter:
+    fmt = (f"[%(asctime)s p{jax.process_index()}] "
+           "(%(filename)s:%(lineno)d) %(levelname)s: %(message)s")
+    return logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S")
+
+
+def _attach_file(logger: logging.Logger, name: str,
+                 output_dir: str) -> None:
+    if output_dir in _LOGGER_DIRS.setdefault(name, set()):
+        return
+    os.makedirs(output_dir, exist_ok=True)
+    fh = logging.FileHandler(
+        os.path.join(output_dir, f"log_p{jax.process_index()}.txt"))
+    fh.setLevel(logging.DEBUG)
+    fh.setFormatter(_fmt())
+    logger.addHandler(fh)
+    _LOGGER_DIRS[name].add(output_dir)
+
+
 def create_logger(name: str = "dltpu", output_dir: Optional[str] = None,
                   to_console: bool = True) -> logging.Logger:
-    """Formatted logger; console on process 0 only, per-process file logs."""
+    """Formatted logger; console on process 0 only, per-process file logs.
+
+    Cached by ``name``, but an ``output_dir`` the cached logger has not
+    seen yet still gets a file handler — so two sequential runs in one
+    process each produce their own log file."""
     if name in _LOGGERS:
-        return _LOGGERS[name]
+        logger = _LOGGERS[name]
+        if output_dir:
+            _attach_file(logger, name, output_dir)
+        return logger
     logger = logging.getLogger(name)
     logger.setLevel(logging.DEBUG)
     logger.propagate = False
-    fmt = (f"[%(asctime)s p{jax.process_index()}] "
-           "(%(filename)s:%(lineno)d) %(levelname)s: %(message)s")
     if to_console and is_main_process():
         h = logging.StreamHandler(sys.stdout)
         h.setLevel(logging.INFO)
-        h.setFormatter(logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S"))
+        h.setFormatter(_fmt())
         logger.addHandler(h)
-    if output_dir:
-        os.makedirs(output_dir, exist_ok=True)
-        fh = logging.FileHandler(
-            os.path.join(output_dir, f"log_p{jax.process_index()}.txt"))
-        fh.setLevel(logging.DEBUG)
-        fh.setFormatter(logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S"))
-        logger.addHandler(fh)
     _LOGGERS[name] = logger
+    if output_dir:
+        _attach_file(logger, name, output_dir)
     return logger
 
 

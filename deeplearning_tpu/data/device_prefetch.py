@@ -37,6 +37,7 @@ from typing import Any, Dict, Iterator, Optional
 import jax
 import numpy as np
 
+from ..obs import spans
 from ..parallel.sharding import make_global_array
 
 _END = object()          # producer exhausted its epoch normally
@@ -139,6 +140,12 @@ class DevicePrefetcher:
                 t2 = time.perf_counter()
                 self.source_wait_total += t1 - t0
                 self.h2d_wait_total += t2 - t1
+                # trace lanes from the worker thread — reuses the clock
+                # reads above, so the disabled path costs one None check
+                tracer = spans.get_tracer()
+                if tracer is not None:
+                    tracer.record("feed/decode", t0, t1 - t0)
+                    tracer.record("feed/h2d", t1, t2 - t1)
                 # bounded put that stays responsive to shutdown
                 while not stop.is_set():
                     try:

@@ -1,0 +1,31 @@
+"""Run-wide observability: span timeline, compile/HBM telemetry, crash
+flight recorder.
+
+Three pieces, one policy (README "Observability policy"):
+
+- ``spans``  — thread-aware ring-buffered host span tracer; emits
+  Chrome trace-event JSON (``trace.json``) and correlates with XLA
+  device traces via ``jax.profiler`` annotations. Near-zero cost when
+  disabled.
+- ``xla``    — compile telemetry (seconds / FLOPs / peak HBM /
+  persistent-cache hit per lowering, via ``tracked_compile``) and
+  device-memory watermarking (``hbm_snapshot`` + the ``HbmWatermark``
+  sampler thread).
+- ``flight`` — bounded ring of recent structured events (step metric
+  snapshots, feed stats, retraces, compiles, serve rejections) dumped
+  to ``flightrec.json`` on divergence abort, uncaught trainer
+  exception, or SIGTERM.
+
+``tools/obs_report.py`` renders a run directory (metrics.jsonl +
+trace.json + flightrec.json) into the phase-time report every ROADMAP
+on-chip calibration item consumes.
+"""
+
+from . import flight, spans, xla
+from .flight import FlightRecorder
+from .spans import SpanTracer, span, step_span, traced
+from .xla import HbmWatermark, hbm_snapshot, tracked_compile
+
+__all__ = ["spans", "xla", "flight", "SpanTracer", "span", "step_span",
+           "traced", "FlightRecorder", "HbmWatermark", "hbm_snapshot",
+           "tracked_compile"]

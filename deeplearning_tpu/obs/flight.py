@@ -1,0 +1,191 @@
+"""Crash flight recorder: last-K structured events + config, dumped on
+divergence abort, uncaught trainer exception, or SIGTERM.
+
+A diverged or preempted run previously left nothing to autopsy — the
+metrics ring dies with the process and the log file stops mid-line. The
+recorder keeps a bounded in-memory ring of recent structured events
+(step metric snapshots, feed stats, retrace warnings, compile events,
+serve rejections — anything a layer ``record()``s) and serializes it to
+``runs/<dir>/flightrec.json`` together with the run config, an HBM
+snapshot, and the exception, the moment something goes wrong.
+
+Recording is always-on and cheap (bounded ``deque.append`` under a
+lock; no device syncs, no I/O); DUMPING requires a path — either
+``configure(path, config)`` (the Trainer does this per run) or an
+explicit ``dump(path=...)``. The default process-wide recorder is what
+the convenience ``record(kind, **data)`` feeds, so layers don't need a
+handle threaded through them.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder", "get_recorder", "record", "configure",
+           "dump", "install_signal_handler"]
+
+
+def _jsonable(obj: Any, depth: int = 0) -> Any:
+    """Best-effort JSON projection: configs arrive as dataclass-dicts,
+    numpy scalars, device arrays — serialize what we can, stringify the
+    rest (a flight record must never fail to write)."""
+    if depth > 6:
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if obj == obj and abs(obj) != float("inf") else repr(obj)
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    if hasattr(obj, "item"):           # numpy / jax scalars
+        try:
+            return _jsonable(obj.item(), depth + 1)
+        except Exception:  # noqa: BLE001
+            pass
+    if hasattr(obj, "__dataclass_fields__"):
+        import dataclasses
+        try:
+            return _jsonable(dataclasses.asdict(obj), depth + 1)
+        except Exception:  # noqa: BLE001
+            pass
+    return repr(obj)
+
+
+class FlightRecorder:
+    """Bounded ring of recent events with a one-shot crash dump."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.path: Optional[str] = None
+        self.config: Optional[Dict[str, Any]] = None
+        self.dumps = 0
+        self.recorded = 0
+
+    # ------------------------------------------------------- recording
+    def record(self, kind: str, **data: Any) -> None:
+        event = {"kind": kind, "time": time.time(),
+                 "thread": threading.current_thread().name, **data}
+        with self._lock:
+            self.recorded += 1
+            self._ring.append(event)
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            ring = list(self._ring)
+        return ring if kind is None else [e for e in ring
+                                          if e["kind"] == kind]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.recorded = 0
+
+    # --------------------------------------------------------- dumping
+    def configure(self, path: str,
+                  config: Optional[Any] = None) -> "FlightRecorder":
+        """Arm the recorder: where to dump and what run config to embed
+        (any object; serialized best-effort)."""
+        self.path = path
+        self.config = _jsonable(config) if config is not None else None
+        return self
+
+    def dump(self, reason: str = "manual", *,
+             exception: Optional[BaseException] = None,
+             path: Optional[str] = None) -> Optional[str]:
+        """Write ``flightrec.json``; returns the path (None when no path
+        is configured — recording without arming is legal). Never raises:
+        this runs inside except blocks and signal handlers."""
+        try:
+            path = path or self.path
+            if not path:
+                return None
+            exc_info = None
+            if exception is not None:
+                exc_info = {
+                    "type": type(exception).__name__,
+                    "message": str(exception),
+                    "traceback": traceback.format_exception(
+                        type(exception), exception,
+                        exception.__traceback__),
+                }
+            from .xla import hbm_snapshot   # lazy: avoid import cycle
+            doc = {
+                "reason": reason,
+                "time": time.time(),
+                "pid": os.getpid(),
+                "config": self.config,
+                "exception": exc_info,
+                "hbm": _jsonable(hbm_snapshot()),
+                "events": _jsonable(self.events()),
+            }
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1)
+            self.dumps += 1
+            return path
+        except Exception:  # noqa: BLE001 - a dump failure must not mask
+            return None    # the original crash
+
+
+# process-wide default recorder: layers record into it without plumbing
+_RECORDER = FlightRecorder()
+_SIGNAL_INSTALLED = False
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, **data: Any) -> None:
+    """Append one event to the default recorder (always cheap/bounded)."""
+    _RECORDER.record(kind, **data)
+
+
+def configure(path: str, config: Optional[Any] = None) -> FlightRecorder:
+    return _RECORDER.configure(path, config)
+
+
+def dump(reason: str = "manual", *,
+         exception: Optional[BaseException] = None,
+         path: Optional[str] = None) -> Optional[str]:
+    return _RECORDER.dump(reason, exception=exception, path=path)
+
+
+def install_signal_handler() -> bool:
+    """Dump on SIGTERM (preemption / driver kill) before the default
+    termination proceeds. Chains any previously-installed handler. Only
+    possible from the main thread; returns False when it isn't."""
+    global _SIGNAL_INSTALLED
+    if _SIGNAL_INSTALLED:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            _RECORDER.dump("sigterm")
+            if callable(previous) and previous not in (
+                    signal.SIG_IGN, signal.SIG_DFL):
+                previous(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, handler)
+        _SIGNAL_INSTALLED = True
+        return True
+    except (ValueError, OSError):      # non-main thread / exotic runtime
+        return False

@@ -1,0 +1,249 @@
+"""Compile + device-memory telemetry.
+
+Every AOT lowering in the library (Trainer.precompile, the serving
+engine's bucket warmup, ``utils.profiling.compiled_flops``) funnels
+through ``tracked_compile``: the compile is timed, XLA's
+``cost_analysis`` (FLOPs) and ``memory_analysis`` (peak HBM) are read
+off the executable, a persistent-cache hit/miss verdict is taken from
+the cache directory, and the event lands in three places at once — the
+bounded ``compile_events()`` ring (the ``/stats`` surface), the span
+timeline (a ``compile/<name>`` span with FLOPs/HBM args), and the
+flight recorder (so a crash dump shows what was compiled when).
+
+HBM watermarking: ``hbm_snapshot()`` reads ``device.memory_stats()``
+(TPU runtimes report ``bytes_in_use``/``peak_bytes_in_use``; CPU
+returns nothing) plus a ``jax.live_arrays()`` census — count and total
+bytes of every live buffer the process holds. ``HbmWatermark`` samples
+that snapshot from its own thread ("obs-metrics") on an interval,
+tracking run-peak values; its samples are spans, so the timeline shows
+memory next to the phases that allocated it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import flight, spans
+
+__all__ = ["tracked_compile", "compile_events", "compile_stats",
+           "memory_analysis_dict", "hbm_snapshot", "HbmWatermark"]
+
+# bounded ring of compile-event dicts (module-wide: compiles are rare
+# and the ring is the natural join point for /stats and obs_report)
+_EVENTS: collections.deque = collections.deque(maxlen=512)
+_EVENTS_LOCK = threading.Lock()
+
+_MEM_FIELDS = ("temp_size_in_bytes", "argument_size_in_bytes",
+               "output_size_in_bytes", "alias_size_in_bytes",
+               "generated_code_size_in_bytes")
+
+
+def memory_analysis_dict(compiled) -> Dict[str, float]:
+    """``Compiled.memory_analysis()`` as a plain dict (missing fields and
+    backends without the analysis yield ``{}`` — never raises)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - analysis is backend-optional
+        return {}
+    if mem is None:
+        return {}
+    out: Dict[str, float] = {}
+    for field in _MEM_FIELDS:
+        val = getattr(mem, field, None)
+        if val is not None:
+            out[field] = float(val)
+    if out:
+        # the executable's device-memory high-water mark: resident
+        # args + outputs + scratch (aliased bytes counted once)
+        out["peak_hbm_bytes"] = (
+            out.get("argument_size_in_bytes", 0.0)
+            + out.get("output_size_in_bytes", 0.0)
+            + out.get("temp_size_in_bytes", 0.0)
+            - out.get("alias_size_in_bytes", 0.0))
+    return out
+
+
+def _cache_entries() -> Optional[int]:
+    """File count in the persistent compile cache (None when disabled)."""
+    import os
+
+    from ..core.compile_cache import active_cache_dir
+    cache_dir = active_cache_dir()
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    try:
+        return len(os.listdir(cache_dir))
+    except OSError:
+        return None
+
+
+def tracked_compile(lowered, name: str):
+    """``lowered.compile()`` with telemetry: returns the executable and
+    records {fn, seconds, flops, peak_hbm_bytes, cache_hit} everywhere
+    the observability stack looks. Never raises past the compile itself
+    — a telemetry failure must not fail a warmup path."""
+    before = _cache_entries()
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    seconds = time.perf_counter() - t0
+    try:
+        from ..utils.profiling import cost_analysis_dict
+        cost = cost_analysis_dict(compiled)
+        mem = memory_analysis_dict(compiled)
+        after = _cache_entries()
+        # no new cache entry materialized -> the persistent cache (or
+        # jit's in-memory executable cache) served this lowering
+        cache_hit = (None if before is None or after is None
+                     else after <= before)
+        event = {
+            "fn": name,
+            "seconds": round(seconds, 4),
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "peak_hbm_bytes": mem.get("peak_hbm_bytes", 0.0),
+            "cache_hit": cache_hit,
+            "time": time.time(),
+        }
+        with _EVENTS_LOCK:
+            _EVENTS.append(event)
+        tracer = spans.get_tracer()
+        if tracer is not None:
+            tracer.record(f"compile/{name}", t0, seconds,
+                          {k: event[k] for k in
+                           ("seconds", "flops", "peak_hbm_bytes",
+                            "cache_hit")})
+        flight.record("compile", **event)
+    except Exception:  # noqa: BLE001 - telemetry never fails a compile
+        pass
+    return compiled
+
+
+def compile_events(last: Optional[int] = None) -> List[Dict[str, Any]]:
+    with _EVENTS_LOCK:
+        events = list(_EVENTS)
+    return events if last is None else events[-last:]
+
+
+def compile_stats() -> Dict[str, float]:
+    """Aggregate view for /stats and bench rows."""
+    events = compile_events()
+    hits = sum(1 for e in events if e.get("cache_hit"))
+    return {
+        "compiles": float(len(events)),
+        "compile_seconds_total": round(
+            sum(e["seconds"] for e in events), 4),
+        "compile_cache_hits": float(hits),
+        "compile_peak_hbm_bytes": max(
+            (e["peak_hbm_bytes"] for e in events), default=0.0),
+    }
+
+
+def clear_compile_events() -> None:
+    with _EVENTS_LOCK:
+        _EVENTS.clear()
+
+
+# ------------------------------------------------------------- memory
+def hbm_snapshot() -> Dict[str, Any]:
+    """One point-in-time device-memory reading; cheap enough to take at
+    crash time and from the sampler thread. Fields that a backend does
+    not report are simply absent."""
+    snap: Dict[str, Any] = {"time": time.time()}
+    try:
+        import jax
+        devices = []
+        for d in jax.devices():
+            entry: Dict[str, Any] = {"id": d.id,
+                                     "kind": getattr(d, "device_kind", "")}
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 - CPU backends raise/None
+                stats = None
+            if stats:
+                for key in ("bytes_in_use", "peak_bytes_in_use",
+                            "bytes_limit", "largest_alloc_size"):
+                    if key in stats:
+                        entry[key] = int(stats[key])
+            devices.append(entry)
+        snap["devices"] = devices
+        arrs = jax.live_arrays()
+        snap["live_arrays"] = {
+            "count": len(arrs),
+            "nbytes": int(sum(getattr(a, "nbytes", 0) for a in arrs)),
+        }
+    except Exception:  # noqa: BLE001 - snapshot is best-effort
+        pass
+    return snap
+
+
+class HbmWatermark:
+    """Background HBM sampler: one daemon thread ("obs-metrics") taking
+    ``hbm_snapshot()`` every ``interval_s``, keeping run-peak watermarks
+    and emitting each sample as a span from its own thread — the third
+    lane of the trace timeline next to the hot loop and the feed worker.
+
+    An immediate first sample on ``start()`` guarantees even a 5-step
+    smoke run records at least one memory point."""
+
+    def __init__(self, interval_s: float = 0.5):
+        self.interval_s = max(float(interval_s), 0.01)
+        self.samples = 0
+        self.peak_live_bytes = 0
+        self.peak_bytes_in_use = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _sample(self) -> None:
+        t0 = time.perf_counter()
+        snap = hbm_snapshot()
+        self.samples += 1
+        live = snap.get("live_arrays", {}).get("nbytes", 0)
+        self.peak_live_bytes = max(self.peak_live_bytes, live)
+        for dev in snap.get("devices", []):
+            in_use = dev.get("bytes_in_use", 0)
+            self.peak_bytes_in_use = max(self.peak_bytes_in_use, in_use)
+        tracer = spans.get_tracer()
+        if tracer is not None:
+            tracer.record("hbm_sample", t0,
+                          time.perf_counter() - t0,
+                          {"live_bytes": live,
+                           "live_count":
+                               snap.get("live_arrays", {}).get("count", 0),
+                           "peak_live_bytes": self.peak_live_bytes})
+
+    def _run(self) -> None:
+        self._sample()                       # guaranteed first point
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._sample()
+            except Exception:  # noqa: BLE001 - sampling is best-effort
+                pass
+
+    def start(self) -> "HbmWatermark":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="obs-metrics", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def watermark(self) -> Dict[str, float]:
+        return {
+            "hbm_samples": float(self.samples),
+            "peak_live_bytes": float(self.peak_live_bytes),
+            "peak_bytes_in_use": float(self.peak_bytes_in_use),
+        }
+
+    def __enter__(self) -> "HbmWatermark":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

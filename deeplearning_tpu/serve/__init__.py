@@ -21,8 +21,9 @@ See README "Serving policy" for the bucket table and overload rules.
 from .admission import AdmissionController, DeadlineExceeded, Rejected
 from .batcher import MicroBatcher, SubmitHandle
 from .engine import InferenceEngine
+from .health import health
 from .telemetry import ServeTelemetry
 
 __all__ = ["InferenceEngine", "MicroBatcher", "SubmitHandle",
            "AdmissionController", "Rejected", "DeadlineExceeded",
-           "ServeTelemetry"]
+           "ServeTelemetry", "health"]

@@ -36,6 +36,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..obs import flight
+from ..obs.spans import span
 from .admission import AdmissionController, DeadlineExceeded
 from .telemetry import ServeTelemetry
 
@@ -178,6 +180,7 @@ class MicroBatcher:
             self.admission.admit(self._q.qsize())
         except Exception:
             self.telemetry.record_reject()
+            flight.record("serve_reject", depth=self._q.qsize())
             raise
         now = time.perf_counter()
         req = _Request(next(self._ids), image, Future(),
@@ -234,9 +237,11 @@ class MicroBatcher:
             bucket = (self.engine.buckets[-1] if shed
                       else self.engine.bucket_for(len(batch)))
             try:
-                padded = self.engine.pad_to_bucket(
-                    np.stack([r.image for r in batch]), bucket)
-                out = self.engine.run(bucket, padded)
+                with span("serve/dispatch", bucket=bucket, n=len(batch),
+                          depth=depth, shed=shed):
+                    padded = self.engine.pad_to_bucket(
+                        np.stack([r.image for r in batch]), bucket)
+                    out = self.engine.run(bucket, padded)
             except BaseException as exc:  # noqa: BLE001 - to the futures
                 for r in batch:
                     if not r.future.done():

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.xla import tracked_compile
+
 __all__ = ["InferenceEngine"]
 
 
@@ -117,6 +119,7 @@ class InferenceEngine:
         # counters: the "zero compiles after warmup" test surface
         self.trace_count = 0        # bumped inside the traced forward
         self.compile_count = 0      # bumped per lower().compile()
+        self.warmup_seconds: Dict[int, float] = {}   # per-bucket warmup
         self._forward = self._make_forward()
         self._executables: Dict[int, Any] = {}
         self._compile_lock = threading.Lock()
@@ -174,7 +177,8 @@ class InferenceEngine:
             if bucket not in self._executables:
                 lowered = jax.jit(self._forward).lower(
                     self._variables, self.bucket_spec(bucket))
-                self._executables[bucket] = lowered.compile()
+                self._executables[bucket] = tracked_compile(
+                    lowered, f"serve/{self.name}/b{bucket}")
                 self.compile_count += 1
         return self._executables[bucket]
 
@@ -188,6 +192,7 @@ class InferenceEngine:
             t0 = time.perf_counter()
             self._compile_bucket(b)
             times[b] = time.perf_counter() - t0
+        self.warmup_seconds.update(times)
         return times
 
     # ------------------------------------------------------- execution
@@ -249,4 +254,7 @@ class InferenceEngine:
             "buckets": list(self.buckets),
             "trace_count": self.trace_count,
             "compile_count": self.compile_count,
+            "warm": self.compile_count >= len(self.buckets),
+            "warmup_seconds": {str(b): round(s, 4)
+                               for b, s in self.warmup_seconds.items()},
         }

@@ -1,0 +1,54 @@
+"""Health surface: the one readiness/overload verdict for a serving
+process.
+
+``GET /healthz`` (tools/serve.py) answers the two questions an operator
+or load balancer actually asks, from state the stack already tracks —
+no device work, no syncs, safe to poll at any rate:
+
+- **Ready?** The engine is *warm* when every batch bucket has its AOT
+  executable (``compile_count >= len(buckets)``) — before that, a
+  request would pay an XLA compile, so the process reports 503 and the
+  balancer keeps traffic away until warmup finishes.
+- **Degraded?** The admission policy's shed verdict on the live queue
+  depth (``AdmissionController.overloaded``). A shedding server still
+  answers — it is maximizing throughput, not down — but it reports 503
+  so upstream can drain toward healthier replicas before the queue
+  converts overload into rejections.
+
+The payload carries the operating numbers next to the verdict (queue
+depth, e2e p99, reject count, bucket table) so a 503 is diagnosable
+from the probe alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+__all__ = ["health"]
+
+
+def health(engine, batcher=None) -> Tuple[int, Dict[str, Any]]:
+    """(http_status, payload) for one engine (+ optional batcher).
+
+    200 "ready": warm engine, not shedding. 503 "warming" until every
+    bucket is compiled; 503 "degraded" while admission sheds. Pure host
+    reads — never compiles, never syncs the device."""
+    warm = engine.compile_count >= len(engine.buckets)
+    depth = batcher.queue_depth if batcher is not None else 0
+    shed = (batcher.admission.overloaded(depth)
+            if batcher is not None else False)
+    status = "ready" if warm and not shed else (
+        "warming" if not warm else "degraded")
+    payload: Dict[str, Any] = {
+        "status": status,
+        "engine_warm": warm,
+        "queue_depth": depth,
+        "shed": shed,
+        "model": engine.name,
+        "task": engine.task,
+        "buckets": list(engine.buckets),
+    }
+    if batcher is not None:
+        payload["e2e_ms_p99"] = batcher.telemetry.latency_ms("e2e")["p99"]
+        payload["rejected"] = batcher.telemetry.rejected
+    return (200 if status == "ready" else 503), payload

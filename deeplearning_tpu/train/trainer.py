@@ -15,6 +15,7 @@ in the jitted step functions it is given.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
@@ -28,6 +29,8 @@ from ..core.logging import (LoggerHub, MetricLogger,
                             TensorBoardWriter, create_logger,
                             is_main_process)
 from ..data.device_prefetch import DevicePrefetcher
+from ..obs import flight
+from ..obs.spans import span, step_span
 from ..utils.profiling import RetraceGuard
 from .async_metrics import DeferredMetrics
 
@@ -75,10 +78,26 @@ class Trainer:
         metrics_window: Optional[int] = None,
         retrace_warn: bool = True,
         prefetch="auto",
+        obs="auto",
+        run_config: Optional[Dict] = None,
+        hbm_sample_s: float = 0.25,
     ):
         self.state = state
-        self.train_step = (RetraceGuard(train_step, name="train_step")
-                           if retrace_warn else train_step)
+        # observability (README "Observability policy"): spans + flight
+        # recorder + HBM sampler. "auto" = on whenever the run has a
+        # workdir to dump trace.json/flightrec.json into; True forces it
+        # (tests), False disables. Retrace warnings always land in the
+        # flight ring — recording is bounded and sync-free.
+        self.obs_enabled = bool(workdir) if obs == "auto" else bool(obs)
+        self.run_config = run_config
+        self.hbm_sample_s = hbm_sample_s
+        self._hbm = None
+        self._obs_owns_tracer = False
+        self._obs_started = False
+        self.train_step = (RetraceGuard(
+            train_step, name="train_step",
+            on_retrace=lambda info: flight.record("retrace", **info))
+            if retrace_warn else train_step)
         # overlapped device feed (see README "Input feed & donation
         # policy"): with a mesh-bearing loader the serial host→HBM
         # transfer is the hot loop's last blocking stage, so auto-wrap it
@@ -97,6 +116,7 @@ class Trainer:
         self.callbacks = callbacks or Callbacks()
         self.metric_reducer = metric_reducer
         self.abort_non_finite = abort_non_finite
+        self.workdir = workdir
         self.logger = create_logger("dltpu", workdir)
         # pluggable backends (yolov5 Loggers shape): tensorboard + csv +
         # offline-W&B jsonl by default; self.tb stays the TB handle for
@@ -174,6 +194,7 @@ class Trainer:
         or None when the loader/step has no AOT surface."""
         from ..core.compile_cache import enable_compile_cache
         enable_compile_cache()
+        self._obs_start()      # the compile span belongs on the timeline
         if hasattr(self.train_loader, "start"):
             self.train_loader.start()         # overlap feed with compile
         spec_fn = getattr(self.train_loader, "element_spec", None)
@@ -184,17 +205,74 @@ class Trainer:
         fn = getattr(self.train_step, "fn", self.train_step)
         if not hasattr(fn, "lower"):
             return None
+        from ..obs.xla import tracked_compile
         t0 = time.perf_counter()
-        self._aot_step = fn.lower(self.state, batch_spec,
-                                  self.rng).compile()
+        self._aot_step = tracked_compile(
+            fn.lower(self.state, batch_spec, self.rng), "train_step")
         dt = time.perf_counter() - t0
         self.precompile_seconds = dt
         self.logger.info(f"precompile: train step AOT-compiled in "
                          f"{dt:.2f}s (overlapped with feed warmup)")
         return dt
 
+    # ----------------------------------------------------- observability
+    def _obs_config(self) -> Dict[str, Any]:
+        """Run config embedded in flightrec.json: the caller's full cfg
+        when provided (tools/train.py), else the Trainer's own knobs."""
+        if self.run_config is not None:
+            return self.run_config
+        return {"epochs": self.epochs, "log_every": self.log_every,
+                "metrics_lag": self.metrics_lag,
+                "metrics_window": self.metrics_window,
+                "best_metric": self.best_metric,
+                "workdir": self.workdir}
+
+    def _obs_start(self) -> None:
+        """Idempotent: called from both ``precompile()`` (so the AOT
+        compile span lands on the timeline) and ``train()``."""
+        if not self.obs_enabled or self._obs_started:
+            return
+        self._obs_started = True
+        from ..obs import spans
+        from ..obs.xla import HbmWatermark
+        self._obs_owns_tracer = not spans.enabled()
+        spans.enable()
+        if self.workdir:
+            flight.configure(os.path.join(self.workdir, "flightrec.json"),
+                             config=self._obs_config())
+            flight.install_signal_handler()
+        self._hbm = HbmWatermark(interval_s=self.hbm_sample_s).start()
+
+    def _obs_finish(self) -> None:
+        if not self.obs_enabled:
+            return
+        from ..obs import spans
+        if self._hbm is not None:
+            self._hbm.stop()
+            self.hbm_watermark = self._hbm.watermark()
+        tracer = spans.get_tracer()
+        if tracer is not None and self.workdir:
+            tracer.dump(os.path.join(self.workdir, "trace.json"))
+        if self._obs_owns_tracer:
+            spans.disable()
+        self._obs_started = False      # a second train() re-arms
+
     # ------------------------------------------------------------- train
     def train(self) -> Any:
+        self._obs_start()
+        try:
+            return self._train()
+        except BaseException as exc:
+            if self.obs_enabled:
+                reason = ("divergence"
+                          if isinstance(exc, FloatingPointError)
+                          else "exception")
+                flight.dump(reason, exception=exc)
+            raise
+        finally:
+            self._obs_finish()
+
+    def _train(self) -> Any:
         if self.ckpt:
             restored, step = self.ckpt.auto_resume(self.state)
             if step:
@@ -240,7 +318,17 @@ class Trainer:
         self.host_step          # seed the host mirror before the loop
         n_iter = len(self.train_loader)
         t_data = time.time()
-        for it, batch in enumerate(self.train_loader):
+        batches = iter(self.train_loader)
+        it = 0
+        while True:
+            # data-wait phase: host blocked on the (possibly prefetched)
+            # loader — on the span timeline this is the slice the feed
+            # follow-ups in ROADMAP.md need to see shrink
+            with span("data_wait", epoch=epoch):
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
             wall_wait = time.time() - t_data
             # prefer the loader's own queue-empty estimate (actual
             # starvation) over wall-clock-between-iterations, which
@@ -250,27 +338,36 @@ class Trainer:
             data_time = loader_wait if loader_wait is not None else \
                 wall_wait
             self.callbacks.fire("before_iter", self, batch=batch)
-            self.state, metrics = self.train_step(self.state, batch,
-                                                  self.rng)
+            # dispatch phase: enqueue the jitted step (async — this span
+            # measures host dispatch, not device compute; StepTrace-
+            # annotated so a concurrent XLA trace aligns device ops)
+            with step_span("dispatch", self.host_step):
+                self.state, metrics = self.train_step(self.state, batch,
+                                                      self.rng)
             self.callbacks.fire("after_iter", self, metrics=metrics)
             self._host_step = self.host_step + 1
             self.deferred.push(metrics, epoch=epoch, it=it,
                                step=self.host_step, n_iter=n_iter,
                                data_time=data_time)
             if it % self.log_every == 0:
-                self._consume(self.deferred.poll())
+                with span("metrics_flush"):
+                    self._consume(self.deferred.poll())
             t_data = time.time()
+            it += 1
         # epoch-end barrier: one bulk fetch lands every remaining entry,
         # so short epochs still log and a NaN in the tail still aborts
-        self._consume(self.deferred.drain())
+        with span("metrics_flush", drain=True):
+            self._consume(self.deferred.drain())
         # feed telemetry (DevicePrefetcher): queue occupancy + H2D wait
         # land next to the train scalars so an input-bound epoch is
         # visible without a profiler
         feed_stats = getattr(self.train_loader, "stats", None)
         if feed_stats is not None:
-            self.hub.scalars({f"feed/{k}": v
-                              for k, v in feed_stats().items()},
+            stats = feed_stats()
+            self.hub.scalars({f"feed/{k}": v for k, v in stats.items()},
                              self.host_step)
+            if self.obs_enabled:
+                flight.record("feed", epoch=epoch, **stats)
             reset = getattr(self.train_loader, "reset_stats", None)
             if reset is not None:
                 reset()
@@ -280,6 +377,14 @@ class Trainer:
         newest one (the stale snapshot that stands in for 'now')."""
         if not entries:
             return
+        if self.obs_enabled:
+            # flight ring: one structured snapshot per materialized
+            # entry, so a crash dump carries the last-K step metrics
+            for meta, host in entries:
+                flight.record("step", step=meta.get("step"),
+                              epoch=meta.get("epoch"), it=meta.get("it"),
+                              data_time=meta.get("data_time"),
+                              metrics=host)
         if self.abort_non_finite:
             for meta, host in entries:
                 # bad_step is the jitted isfinite(loss) flag; the loss
@@ -290,6 +395,12 @@ class Trainer:
                     self.logger.error(
                         f"Loss is {host.get('loss')}, stopping training "
                         f"(epoch {meta['epoch']} it {meta['it']})")
+                    if self.obs_enabled:
+                        flight.record("divergence",
+                                      step=meta.get("step"),
+                                      epoch=meta["epoch"],
+                                      it=meta["it"],
+                                      loss=host.get("loss"))
                     raise FloatingPointError(
                         f"non-finite loss {host.get('loss')} at epoch "
                         f"{meta['epoch']} it {meta['it']}")
@@ -309,9 +420,11 @@ class Trainer:
         the loop runs (dispatch only), then ONE ``jax.device_get`` lands
         the whole list. Host-side accumulation order matches the old
         per-batch-float path exactly, so totals are bitwise identical."""
-        per_batch = [self.eval_step(self.state, batch)
-                     for batch in self.eval_loader]
-        host_counts = jax.device_get(per_batch)   # the one materialization
+        with span("eval", epoch=self.epoch):
+            per_batch = [self.eval_step(self.state, batch)
+                         for batch in self.eval_loader]
+            # the one materialization
+            host_counts = jax.device_get(per_batch)
         self.eval_fetches += 1
         totals: Dict[str, float] = defaultdict(float)
         for counts in host_counts:
@@ -339,9 +452,10 @@ class Trainer:
 
     def _save(self, is_best: bool = False) -> None:
         step = int(self.state.step)
-        self.ckpt.save(step, self.state,
-                       metrics={self.best_metric: self.best_value},
-                       is_best=is_best)
+        with span("checkpoint", step=step, best=is_best):
+            self.ckpt.save(step, self.state,
+                           metrics={self.best_metric: self.best_value},
+                           is_best=is_best)
         self.callbacks.fire("on_checkpoint", self, step=step)
 
     # -------------------------------------------------- throughput mode

@@ -45,7 +45,13 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self):
+        # stop() without a matching start() (callback fired before the
+        # loop primed the timer) records nothing instead of raising a
+        # TypeError on the None arithmetic
+        if self._t0 is None:
+            return
         self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
 
     @property
     def mean(self) -> float:
@@ -68,11 +74,16 @@ class RetraceGuard:
     """
 
     def __init__(self, fn: Callable, name: str = "step",
-                 logger=None, max_warnings: int = 8):
+                 logger=None, max_warnings: int = 8,
+                 on_retrace: Optional[Callable[[Dict], None]] = None):
         self.fn = fn
         self.name = name
         self.logger = logger
         self.max_warnings = max_warnings
+        # observability hook: called with {name, retraces, n_signatures}
+        # on every retrace (the Trainer routes it into the flight
+        # recorder ring) — fires even past the max_warnings cap
+        self.on_retrace = on_retrace
         self._sigs: set = set()
         self.retraces = 0          # new signatures seen after the first
 
@@ -98,6 +109,10 @@ class RetraceGuard:
             self._sigs.add(sig)
             if len(self._sigs) > 1:
                 self.retraces += 1
+                if self.on_retrace is not None:
+                    self.on_retrace({"name": self.name,
+                                     "retraces": self.retraces,
+                                     "n_signatures": len(self._sigs)})
                 if self.retraces <= self.max_warnings:
                     msg = (f"{self.name}: argument signature changed "
                            f"({len(self._sigs)} distinct signatures seen) "
@@ -119,8 +134,10 @@ def cost_analysis_dict(compiled) -> Dict[str, float]:
 
 
 def compiled_flops(fn: Callable, *args) -> float:
-    cost = cost_analysis_dict(jax.jit(fn).lower(*args).compile())
-    return float(cost.get("flops", 0.0))
+    from ..obs.xla import tracked_compile   # lazy: obs imports this module
+    compiled = tracked_compile(jax.jit(fn).lower(*args),
+                               getattr(fn, "__name__", "flops_probe"))
+    return float(cost_analysis_dict(compiled).get("flops", 0.0))
 
 
 def measure_mfu(step_fn: Callable, args: tuple, n_steps: int = 10,
@@ -146,6 +163,8 @@ def measure_mfu(step_fn: Callable, args: tuple, n_steps: int = 10,
 @contextlib.contextmanager
 def trace(logdir: str):
     """jax.profiler trace for TensorBoard's profile plugin."""
+    import os
+    os.makedirs(logdir, exist_ok=True)   # fresh run dirs must not fail
     jax.profiler.start_trace(logdir)
     try:
         yield

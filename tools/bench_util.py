@@ -97,3 +97,47 @@ def bench(fn, args, n=30, warmup=3):
         out = fn(*args)
     sync(out)
     return (time.perf_counter() - t0) / n
+
+
+def obs_overhead(step_fn, args, n=30, reps=3, budget_pct=2.0):
+    """A/B the span-instrumented hot loop: the same ``step_fn(*args)``
+    loop timed with tracing disabled vs enabled (each step bracketed in
+    a ``step_span``, the Trainer's per-step instrumentation). Min-of-reps
+    per arm absorbs host jitter — this measures the instrumentation
+    floor, not scheduler noise. Returns the README "Observability
+    policy" contract numbers: ``within_budget`` is the <=``budget_pct``%
+    overhead assertion the bench smoke rides on."""
+    from deeplearning_tpu.obs import spans
+
+    def loop(instrument):
+        out = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            if instrument:
+                with spans.step_span("dispatch", i):
+                    out = step_fn(*args)
+            else:
+                out = step_fn(*args)
+        sync(out)
+        return time.perf_counter() - t0
+
+    # warmup: compile + touch both code paths once
+    sync(step_fn(*args))
+    was_enabled = spans.enabled()
+    off = ms_on = float("inf")
+    try:
+        for _ in range(reps):
+            spans.disable()
+            off = min(off, loop(False))
+            spans.enable()
+            ms_on = min(ms_on, loop(True))
+    finally:
+        spans.enable() if was_enabled else spans.disable()
+    overhead_pct = (ms_on - off) / off * 100.0 if off > 0 else 0.0
+    return {
+        "spans_off_ms": round(off / n * 1e3, 4),
+        "spans_on_ms": round(ms_on / n * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "within_budget": overhead_pct <= budget_pct,
+        "budget_pct": budget_pct,
+    }

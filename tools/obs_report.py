@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Render one run directory's observability artifacts into a report.
+
+  python tools/obs_report.py runs/exp1             # text report
+  python tools/obs_report.py runs/exp1 --json      # machine-readable
+  python tools/obs_report.py --check               # self-test (tier-1)
+
+Consumes what the Trainer writes per run — ``trace.json`` (the span
+timeline), ``flightrec.json`` (crash flight record, if the run died),
+``metrics.jsonl`` (the jsonl logger backend) — and answers the question
+every on-chip calibration item starts from: *where did the wall time
+go?* Phases (data_wait / dispatch / metrics_flush / eval / checkpoint)
+are summed per span name across threads, compiles get their own table
+(seconds, FLOPs, peak HBM, cache verdict from the ``compile/*`` span
+args), and a flight record is summarized instead of pasted.
+
+``--check`` builds a synthetic run dir through the REAL SpanTracer +
+FlightRecorder APIs, renders it, and asserts on the output — a
+dependency-free self-test the tier-1 suite can run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the phase spans the Trainer emits on its consumer thread, in hot-loop
+# order; everything else in the trace lands under "other spans"
+PHASES = ("data_wait", "dispatch", "metrics_flush", "eval", "checkpoint")
+
+
+def load_trace(run_dir: str) -> List[Dict[str, Any]]:
+    path = os.path.join(run_dir, "trace.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return json.load(f).get("traceEvents", [])
+
+
+def load_flight(run_dir: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(run_dir, "flightrec.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_metrics(run_dir: str) -> List[Dict[str, Any]]:
+    path = os.path.join(run_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    return rows
+
+
+def summarize(run_dir: str) -> Dict[str, Any]:
+    """One dict per run dir: phase totals, thread lanes, compile table,
+    flight/metrics summaries. Pure file reads — never imports jax."""
+    events = load_trace(run_dir)
+    spans = [e for e in events if e.get("ph") == "X"]
+    threads = {e["args"]["name"] for e in events
+               if e.get("ph") == "M" and e.get("name") == "thread_name"}
+
+    totals: Dict[str, Dict[str, float]] = {}
+    for e in spans:
+        name = e["name"]
+        agg = totals.setdefault(name, {"count": 0, "total_ms": 0.0})
+        agg["count"] += 1
+        agg["total_ms"] += e.get("dur", 0.0) / 1e3
+    # wall time = extent of the trace (all threads), the denominator
+    # every phase percentage is against
+    wall_ms = 0.0
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+        wall_ms = (t1 - t0) / 1e3
+
+    phases = {}
+    for name in PHASES:
+        agg = totals.get(name)
+        if agg:
+            phases[name] = {
+                "count": int(agg["count"]),
+                "total_ms": round(agg["total_ms"], 3),
+                "pct_wall": round(agg["total_ms"] / wall_ms * 100.0, 2)
+                if wall_ms else 0.0,
+            }
+    other = {name: {"count": int(a["count"]),
+                    "total_ms": round(a["total_ms"], 3)}
+             for name, a in sorted(totals.items())
+             if name not in PHASES and not name.startswith("compile/")}
+
+    compiles = [{"fn": e["name"][len("compile/"):],
+                 "ms": round(e.get("dur", 0.0) / 1e3, 1),
+                 **{k: e.get("args", {}).get(k) for k in
+                    ("flops", "peak_hbm_bytes", "cache_hit")}}
+                for e in spans if e["name"].startswith("compile/")]
+
+    out: Dict[str, Any] = {
+        "run_dir": run_dir,
+        "wall_ms": round(wall_ms, 3),
+        "threads": sorted(threads),
+        "phases": phases,
+        "compiles": compiles,
+        "other_spans": other,
+    }
+
+    flight = load_flight(run_dir)
+    if flight is not None:
+        kinds: Dict[str, int] = {}
+        for e in flight.get("events", []):
+            kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+        exc = flight.get("exception") or {}
+        out["flight"] = {
+            "reason": flight.get("reason"),
+            "n_events": len(flight.get("events", [])),
+            "event_kinds": kinds,
+            "exception": (f"{exc.get('type')}: {exc.get('message')}"
+                          if exc else None),
+        }
+
+    rows = load_metrics(run_dir)
+    if rows:
+        steps = [r for r in rows if not r.get("summary")]
+        out["metrics"] = {"rows": len(rows), "steps": len(steps)}
+        if steps:
+            last = steps[-1]
+            out["metrics"]["last"] = {
+                k: v for k, v in last.items()
+                if isinstance(v, (int, float)) and k != "time"}
+    return out
+
+
+def render(summary: Dict[str, Any]) -> str:
+    lines = [f"run: {summary['run_dir']}",
+             f"wall: {summary['wall_ms']:.1f} ms   "
+             f"threads: {', '.join(summary['threads']) or '(no trace)'}"]
+    if summary["phases"]:
+        lines.append("")
+        lines.append(f"{'phase':<15s} {'count':>7s} {'total ms':>10s} "
+                     f"{'% wall':>7s}")
+        for name in PHASES:
+            p = summary["phases"].get(name)
+            if p:
+                lines.append(f"{name:<15s} {p['count']:>7d} "
+                             f"{p['total_ms']:>10.1f} "
+                             f"{p['pct_wall']:>6.1f}%")
+    if summary["compiles"]:
+        lines.append("")
+        lines.append(f"{'compile':<28s} {'ms':>9s} {'GFLOPs':>9s} "
+                     f"{'HBM MB':>8s} {'cache':>6s}")
+        for c in summary["compiles"]:
+            flops = (c.get("flops") or 0.0) / 1e9
+            hbm = (c.get("peak_hbm_bytes") or 0.0) / 1e6
+            hit = {True: "hit", False: "miss", None: "n/a"}[
+                c.get("cache_hit")]
+            lines.append(f"{c['fn']:<28s} {c['ms']:>9.1f} {flops:>9.2f} "
+                         f"{hbm:>8.1f} {hit:>6s}")
+    if summary.get("other_spans"):
+        lines.append("")
+        lines.append("other spans: " + ", ".join(
+            f"{k}×{v['count']} ({v['total_ms']:.1f} ms)"
+            for k, v in summary["other_spans"].items()))
+    fl = summary.get("flight")
+    if fl:
+        lines.append("")
+        lines.append(f"flight record: reason={fl['reason']} "
+                     f"events={fl['n_events']} "
+                     f"kinds={fl['event_kinds']}")
+        if fl.get("exception"):
+            lines.append(f"  exception: {fl['exception']}")
+    m = summary.get("metrics")
+    if m:
+        lines.append("")
+        lines.append(f"metrics.jsonl: {m['rows']} rows"
+                     + (f", last step {m['last']}" if m.get("last")
+                        else ""))
+    return "\n".join(lines)
+
+
+def _check() -> int:
+    """Self-test: synthesize a run dir through the real obs APIs, render
+    it, assert the report carries every section. No jax import, no
+    device — safe in the tier-1 window."""
+    import tempfile
+    import time
+
+    from deeplearning_tpu.obs.flight import FlightRecorder
+    from deeplearning_tpu.obs.spans import SpanTracer
+
+    with tempfile.TemporaryDirectory() as run_dir:
+        tracer = SpanTracer(capacity=64)
+        t0 = time.perf_counter()
+        for i in range(3):
+            tracer.record("data_wait", t0 + i * 0.01, 0.002)
+            tracer.record("dispatch", t0 + i * 0.01 + 0.002, 0.007)
+            tracer.record("metrics_flush", t0 + i * 0.01 + 0.009, 0.001)
+        tracer.record("eval", t0 + 0.03, 0.005)
+        tracer.record("compile/train_step", t0, 0.25,
+                      {"seconds": 0.25, "flops": 2.5e9,
+                       "peak_hbm_bytes": 1.5e6, "cache_hit": False})
+        tracer.dump(os.path.join(run_dir, "trace.json"))
+
+        rec = FlightRecorder(capacity=16)
+        rec.record("step", step=1, loss=0.9)
+        rec.record("step", step=2, loss=float("nan"))
+        rec.record("divergence", step=2)
+        rec.configure(os.path.join(run_dir, "flightrec.json"),
+                      {"model": "mnist_fcn", "batch": 64})
+        assert rec.dump("divergence",
+                        exception=FloatingPointError("loss=nan"))
+
+        with open(os.path.join(run_dir, "metrics.jsonl"), "w") as f:
+            f.write(json.dumps({"step": 1, "time": 0.0,
+                                "train/loss": 0.9}) + "\n")
+            f.write(json.dumps({"step": 2, "time": 0.1,
+                                "train/loss": 1e9}) + "\n")
+
+        summary = summarize(run_dir)
+        report = render(summary)
+
+        assert summary["phases"]["data_wait"]["count"] == 3, summary
+        assert summary["phases"]["dispatch"]["pct_wall"] > 0, summary
+        assert summary["compiles"][0]["fn"] == "train_step", summary
+        assert summary["compiles"][0]["cache_hit"] is False, summary
+        assert summary["flight"]["reason"] == "divergence", summary
+        assert summary["flight"]["event_kinds"]["step"] == 2, summary
+        assert "FloatingPointError" in summary["flight"]["exception"]
+        assert summary["metrics"]["rows"] == 2, summary
+        for token in ("data_wait", "train_step", "divergence"):
+            assert token in report, report
+    print("obs_report --check: ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="run directory (runs/<name>)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="self-test on a synthetic run dir")
+    args = ap.parse_args(argv)
+    if args.check:
+        return _check()
+    if not args.run_dir:
+        ap.error("run_dir required (or --check)")
+    if not os.path.isdir(args.run_dir):
+        print(f"not a directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    summary = summarize(args.run_dir)
+    print(json.dumps(summary, indent=1) if args.json
+          else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

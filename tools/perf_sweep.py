@@ -384,11 +384,65 @@ def time_serve_set(results_path=None):
                              model="vit_base_patch16_224")
 
 
+def time_obs_set(results_path=None):
+    """Observability-overhead A/B (obs/spans.py): the same jitted train
+    step timed with span tracing disabled vs enabled (per-step
+    ``step_span`` bracketing, min-of-reps). The rows quantify the README
+    "Observability policy" <2% budget on the real step; on CPU a small
+    model keeps the run inside the tier-1 window, on TPU the ViT-B/16
+    step gives the production number."""
+    from bench_util import append_op_result, obs_overhead
+
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.train import TrainState, make_train_step
+    from deeplearning_tpu.train.classification import make_loss_fn
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_name, size, chans, batch = (
+        ("vit_base_patch16_224", 224, 3, 128) if on_tpu
+        else ("mnist_fcn", 28, 1, 64))
+    model = MODELS.build(model_name, num_classes=1000 if on_tpu else 10)
+    rng = jax.random.key(0)
+    params = model.init(rng, jnp.zeros((1, size, size, chans)),
+                        train=False)["params"]
+    tx = build_optimizer("sgd", build_schedule("constant", base_lr=1e-2),
+                         params=params)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    gen = np.random.default_rng(0)
+    data = {"image": jnp.asarray(gen.normal(
+                size=(batch, size, size, chans)), jnp.float32),
+            "label": jnp.asarray(gen.integers(
+                0, 1000 if on_tpu else 10, batch), jnp.int32)}
+    step = jax.jit(make_train_step(make_loss_fn()))
+
+    def one_step(s, b, r):
+        _, m = step(s, b, r)
+        return m["loss"]
+
+    n = 20 if on_tpu else 50
+    res = obs_overhead(one_step, (state, data, rng), n=n, reps=3)
+    print(f"obs_spans_off {model_name} {res['spans_off_ms']:9.3f} ms/step",
+          flush=True)
+    print(f"obs_spans_on  {model_name} {res['spans_on_ms']:9.3f} ms/step "
+          f"overhead={res['overhead_pct']:+.3f}% "
+          f"within_2pct={res['within_budget']}", flush=True)
+    if results_path:
+        append_op_result(results_path, "obs_spans_off", n=n,
+                         ms=res["spans_off_ms"], model=model_name)
+        append_op_result(results_path, "obs_spans_on", n=n,
+                         ms=res["spans_on_ms"], model=model_name,
+                         overhead_pct=res["overhead_pct"],
+                         within_2pct=res["within_budget"])
+    return res
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
                     choices=["batch", "attn", "all", "r5", "decomp",
-                             "feed", "detect", "serve"])
+                             "feed", "detect", "serve", "obs"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -418,6 +472,8 @@ def main():
         time_detect_set(results_path=results)
     if args.set == "serve":
         time_serve_set(results_path=results)
+    if args.set == "obs":
+        time_obs_set(results_path=results)
     if args.set == "feed":
         # feed-side A/B for the MFU claim: serial blocking H2D vs the
         # threaded prefetch pipeline, same step, real per-iter batches

@@ -132,7 +132,9 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     import io
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from deeplearning_tpu.obs import xla as obs_xla
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+    from deeplearning_tpu.serve.health import health as health_check
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):   # quiet: telemetry is the log
@@ -147,9 +149,17 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path.rstrip("/") == "/stats":
-                return self._json(200, batcher.telemetry.snapshot())
-            return self._json(404, {"error": "GET /stats only"})
+            route = self.path.rstrip("/")
+            if route == "/stats":
+                payload = batcher.telemetry.snapshot()
+                payload["engine"] = batcher.engine.stats()
+                payload["compile"] = obs_xla.compile_stats()
+                payload["hbm"] = obs_xla.hbm_snapshot()
+                return self._json(200, payload)
+            if route == "/healthz":
+                code, payload = health_check(batcher.engine, batcher)
+                return self._json(code, payload)
+            return self._json(404, {"error": "GET /stats or /healthz"})
 
         def do_POST(self):
             if self.path.rstrip("/") != "/predict":
@@ -178,7 +188,8 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     print(json.dumps({"serving": f"http://127.0.0.1:{server.server_port}",
-                      "endpoints": ["/predict", "/stats"]}), flush=True)
+                      "endpoints": ["/predict", "/stats", "/healthz"]}),
+          flush=True)
     return server
 
 

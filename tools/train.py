@@ -325,7 +325,10 @@ def main(argv=None) -> int:
         workdir=cfg.train.workdir,
         async_checkpoint=cfg.train.async_checkpoint,
         log_every=max(steps_per_epoch // 2, 1),
-        prefetch=cfg.data.prefetch)
+        prefetch=cfg.data.prefetch,
+        # full config into the flight recorder: a flightrec.json from a
+        # crashed run identifies the exact run that produced it
+        run_config=dataclasses.asdict(cfg))
     if cfg.train.precompile:
         try:
             # AOT step compile runs while the prefetcher's worker thread
