@@ -232,40 +232,61 @@ def _obs_smoke():
 def _health_probe():
     """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
     must complete within _PROBE_DEADLINE_S, else report and exit instead of
-    burning the whole bench budget discovering the tunnel is down."""
+    burning the whole bench budget discovering the tunnel is down.
+
+    The stall classification runs through the elastic subsystem's
+    WedgeDetector (the same slow-vs-wedged logic the run supervisor
+    uses): the probe's progress counter freezing past the deadline flips
+    this round to the CPU-fallback sections in bounded time and records
+    a ``wedge`` flight event; a second insurance detector watches the
+    fallback sections themselves and hard-exits if even CPU wedges."""
+    from deeplearning_tpu.elastic.supervisor import WedgeDetector
+    from deeplearning_tpu.obs import flight
+
     ok = threading.Event()
+    progress = [0]                 # bumped as probe/fallback stages land
 
-    def probe_watchdog():
-        if not ok.wait(_PROBE_DEADLINE_S):
-            # TPU never answered — run the CPU op section so the recorded
-            # BENCH json still says something quantitative about this
-            # round's code. Insurance timer: if even the CPU path wedges,
-            # hard-exit anyway.
-            t = threading.Timer(240.0, lambda: os._exit(3))
-            t.daemon = True
-            t.start()
-            try:
-                cpu_fallback = _cpu_op_microbench()
-            except Exception as e:  # noqa: BLE001 - fallback best-effort
-                cpu_fallback = {"error": repr(e)}
-            try:
-                cpu_fallback["serve"] = _serve_smoke()
-            except Exception as e:  # noqa: BLE001 - fallback best-effort
-                cpu_fallback["serve"] = {"error": repr(e)}
-            try:
-                cpu_fallback["obs"] = _obs_smoke()
-            except Exception as e:  # noqa: BLE001 - fallback best-effort
-                cpu_fallback["obs"] = {"error": repr(e)}
-            print(json.dumps({
-                "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
-                "vs_baseline": 0.0, "error": "health probe timeout: device "
-                f"unreachable within {_PROBE_DEADLINE_S}s (tunnel wedge)",
-                "cpu_fallback": cpu_fallback,
-                "last_good_run": _last_good()}),
-                flush=True)
-            os._exit(3)
+    def on_wedge(stalled_s):
+        # TPU never answered — record the wedge where an autopsy will
+        # find it, then run the CPU op section so the recorded BENCH
+        # json still says something quantitative about this round's code.
+        flight.record("wedge", where="bench_health_probe",
+                      stalled_s=round(stalled_s, 1),
+                      deadline_s=_PROBE_DEADLINE_S)
+        flight.dump("bench_wedge",
+                    path=os.path.join("runs", "flightrec_bench.json"),
+                    include_hbm=False)   # the device is the suspect
+        insurance = WedgeDetector(240.0)
+        insurance.watch(lambda: progress[0],
+                        lambda s: os._exit(3), poll_s=5.0,
+                        name="bench-insurance")
+        try:
+            cpu_fallback = _cpu_op_microbench()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["serve"] = _serve_smoke()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["serve"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["obs"] = _obs_smoke()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["obs"] = {"error": repr(e)}
+        progress[0] += 1
+        print(json.dumps({
+            "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
+            "vs_baseline": 0.0, "error": "health probe timeout: device "
+            f"unreachable within {_PROBE_DEADLINE_S}s (tunnel wedge)",
+            "cpu_fallback": cpu_fallback,
+            "last_good_run": _last_good()}),
+            flush=True)
+        os._exit(3)
 
-    threading.Thread(target=probe_watchdog, daemon=True).start()
+    WedgeDetector(_PROBE_DEADLINE_S).watch(
+        lambda: progress[0], on_wedge, poll_s=1.0, stop=ok,
+        name="bench-probe-watch")
     x = jnp.ones((256, 256), jnp.bfloat16)
     val = float(jnp.asarray(x @ x, jnp.float32)[0, 0])  # D2H forces sync
     if val != 256.0:

@@ -15,6 +15,7 @@ unlike the reference no "rank 0 only" guard is needed around saves.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
@@ -65,7 +66,12 @@ class CheckpointManager:
             shutil.copytree(src, best)
 
     def save(self, step: int, state: Any, metrics: Optional[Dict] = None,
-             is_best: bool = False) -> None:
+             is_best: bool = False,
+             topology: Optional[Dict[str, Any]] = None) -> None:
+        """``topology``: fingerprint dict (``elastic.topology.
+        current_topology``) recorded in a JSON sidecar next to the step,
+        so a resume on different hardware can tell — and report — that
+        it is re-sharding."""
         if self._pending_best is not None:
             # the previous async write has committed by now; copy its
             # best BEFORE this save can trigger max_to_keep GC of it
@@ -73,6 +79,8 @@ class CheckpointManager:
             self._finish_pending_best()
         self._mgr.save(step, args=ocp.args.StandardSave(state),
                        metrics=metrics)
+        if topology is not None:
+            self._write_topology(step, topology)
         if not self._async:
             self._mgr.wait_until_finished()
         if is_best:
@@ -83,6 +91,54 @@ class CheckpointManager:
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
         self._finish_pending_best()
+
+    def flush(self) -> None:
+        """Barrier: block until every in-flight async write has
+        committed. This is what the preemption guard calls from the
+        SIGTERM handler — after it returns, the newest checkpoint on
+        disk is complete and a restart loses nothing."""
+        self.wait_until_finished()
+
+    # -------------------------------------------------- topology sidecar
+    # One JSON file for the whole directory ({step: fingerprint}), not a
+    # file inside each step dir: Orbax owns the step dirs (atomic-rename
+    # commit + GC) and a foreign file there would race both.
+    _TOPOLOGY_KEEP = 32
+
+    def _topology_path(self) -> str:
+        return os.path.join(self.directory, "topology.json")
+
+    def _write_topology(self, step: int, topology: Dict[str, Any]) -> None:
+        if jax.process_index() != 0:
+            return
+        try:
+            docs = self._read_topology_file()
+            docs[str(step)] = topology
+            if len(docs) > self._TOPOLOGY_KEEP:
+                for key in sorted(docs, key=int)[:-self._TOPOLOGY_KEEP]:
+                    del docs[key]
+            tmp = self._topology_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(docs, f, indent=1)
+            os.replace(tmp, self._topology_path())
+        except (OSError, ValueError) as e:
+            self._logger.warning(f"topology sidecar write failed: {e}")
+
+    def _read_topology_file(self) -> Dict[str, Any]:
+        try:
+            with open(self._topology_path()) as f:
+                docs = json.load(f)
+            return docs if isinstance(docs, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def topology(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Fingerprint recorded at ``step`` (default: latest step); None
+        for checkpoints saved without one."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._read_topology_file().get(str(step))
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -97,12 +153,33 @@ class CheckpointManager:
 
     def auto_resume(self, state: Any) -> tuple[Any, int]:
         """Scan the directory for the newest checkpoint and restore it —
-        the swin auto_resume_helper pattern (torch_utils.py:261-271)."""
+        the swin auto_resume_helper pattern (torch_utils.py:261-271).
+        Restores into ``state``'s existing shardings; for resuming onto
+        a *different* mesh use ``elastic.resume.elastic_restore``."""
         step = self.latest_step()
         if step is None:
             return state, 0
         self._logger.info(f"auto-resume from step {step} in {self.directory}")
-        return self.restore(state, step), step
+        restored = self.restore(state, step)
+        try:
+            from ..elastic import topology as topo
+            from ..obs import flight
+            saved = self.topology(step)
+            current = topo.current_topology(state=state)
+            cross = topo.topology_changed(saved, current) \
+                if saved is not None else False
+            flight.record("resume", step=int(step),
+                          cross_topology=bool(cross),
+                          saved_topology=topo.topology_str(saved),
+                          current_topology=topo.topology_str(current))
+            if cross:
+                self._logger.info(
+                    "cross-topology resume: saved on "
+                    f"{topo.topology_str(saved)}, restoring on "
+                    f"{topo.topology_str(current)}")
+        except Exception:  # noqa: BLE001 - telemetry must not block resume
+            pass
+        return restored, step
 
     def close(self) -> None:
         self.wait_until_finished()

@@ -1,0 +1,173 @@
+"""Fault injection: make preemption, crashes, and wedges CPU-testable.
+
+The elastic loop only earns trust if tier-1 can kill it on purpose. The
+Trainer calls :func:`maybe_fire` at two sites — every step boundary
+(``site="step"``) and just before each checkpoint write
+(``site="checkpoint"``) — and this module decides, from the
+``DLTPU_FAULTS`` env var, whether to deliver a fault there.
+
+Grammar (``;``-separated specs, each ``@``-separated fields)::
+
+    DLTPU_FAULTS="sigterm@step:5@attempt:0;crash@checkpoint;wedge@step:3"
+
+    kind      := sigterm | sigint | crash | wedge
+    site      := step[:N] | checkpoint[:N]   (N = fire at host step >= N;
+                                              omitted = first visit)
+    attempt:K := only fire on restart attempt K (DLTPU_RESTART_ATTEMPT,
+                 set by the supervisor; defaults to 0 when unset)
+
+Each spec fires at most once per process. Actions:
+
+- ``sigterm``/``sigint``: ``os.kill(os.getpid(), SIG*)`` — exercises the
+  real handler chain, not a shortcut into the guard.
+- ``crash``: raise :class:`InjectedCrash` (a non-Preempted exception →
+  non-75 exit → the supervisor counts a crash).
+- ``wedge``: block in ``time.sleep`` while the heartbeat writer thread
+  keeps the file fresh — exactly the wedged-device-tunnel signature
+  (process alive, loop stuck) the supervisor must classify and kill.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+__all__ = ["ENV_VAR", "ATTEMPT_VAR", "FaultSpec", "InjectedCrash",
+           "parse_faults", "active_faults", "maybe_fire", "reset"]
+
+ENV_VAR = "DLTPU_FAULTS"
+ATTEMPT_VAR = "DLTPU_RESTART_ATTEMPT"
+
+_KINDS = ("sigterm", "sigint", "crash", "wedge")
+_SITES = ("step", "checkpoint")
+
+# long enough that only the supervisor's wedge kill ends it, short
+# enough that an escaped sleep can't outlive a test suite timeout
+WEDGE_SLEEP_S = 600.0
+
+
+class InjectedCrash(RuntimeError):
+    """The ``crash`` fault: an ordinary hard failure, exit code != 75."""
+
+
+class FaultSpec:
+    __slots__ = ("kind", "site", "at_step", "attempt", "fired")
+
+    def __init__(self, kind: str, site: str, at_step: Optional[int],
+                 attempt: Optional[int]):
+        self.kind = kind
+        self.site = site
+        self.at_step = at_step
+        self.attempt = attempt
+        self.fired = False
+
+    def __repr__(self) -> str:  # shows up in flight events / test output
+        parts = [self.kind, self.site if self.at_step is None
+                 else f"{self.site}:{self.at_step}"]
+        if self.attempt is not None:
+            parts.append(f"attempt:{self.attempt}")
+        return "@".join(parts)
+
+    def matches(self, site: str, step: int, attempt: int) -> bool:
+        if self.fired or self.site != site:
+            return False
+        if self.attempt is not None and self.attempt != attempt:
+            return False
+        if self.at_step is not None and step < self.at_step:
+            return False
+        return True
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """Parse the grammar; malformed specs are skipped (a typo in a fault
+    var should never take down a real run)."""
+    specs: List[FaultSpec] = []
+    for raw in text.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        fields = [f.strip() for f in raw.split("@")]
+        kind = fields[0].lower()
+        if kind not in _KINDS:
+            continue
+        site, at_step, attempt = "step", None, None
+        ok = True
+        for field in fields[1:]:
+            name, _, value = field.partition(":")
+            name = name.lower()
+            if name in _SITES:
+                site = name
+                if value:
+                    try:
+                        at_step = int(value)
+                    except ValueError:
+                        ok = False
+            elif name == "attempt":
+                try:
+                    attempt = int(value)
+                except ValueError:
+                    ok = False
+            else:
+                ok = False
+        if ok:
+            specs.append(FaultSpec(kind, site, at_step, attempt))
+    return specs
+
+
+_SPECS: Optional[List[FaultSpec]] = None
+
+
+def active_faults() -> List[FaultSpec]:
+    global _SPECS
+    if _SPECS is None:
+        _SPECS = parse_faults(os.environ.get(ENV_VAR, ""))
+    return _SPECS
+
+
+def reset() -> None:
+    """Forget parsed state so tests can re-point DLTPU_FAULTS."""
+    global _SPECS
+    _SPECS = None
+
+
+def current_attempt() -> int:
+    try:
+        return int(os.environ.get(ATTEMPT_VAR, "0"))
+    except ValueError:
+        return 0
+
+
+def maybe_fire(site: str, step: int = 0) -> None:
+    """Fire the first matching un-fired fault for this site, if any."""
+    specs = active_faults()
+    if not specs:
+        return
+    attempt = current_attempt()
+    for spec in specs:
+        if not spec.matches(site, step, attempt):
+            continue
+        spec.fired = True
+        _fire(spec, step)
+        return
+
+
+def _fire(spec: FaultSpec, step: int) -> None:
+    from ..obs import flight
+    flight.record("fault_injected", fault=repr(spec), step=int(step))
+    if spec.kind in ("sigterm", "sigint"):
+        signum = signal.SIGTERM if spec.kind == "sigterm" else signal.SIGINT
+        # deliver through the kernel: the registry's dispatcher, the
+        # flight hook, and the preemption guard all run for real
+        os.kill(os.getpid(), signum)
+        return
+    if spec.kind == "crash":
+        raise InjectedCrash(f"injected fault {spec!r} at step {step}")
+    if spec.kind == "wedge":
+        # simulate a blocked device transfer: the main thread stalls,
+        # daemon threads (heartbeat writer) stay alive — the supervisor
+        # must notice the frozen step/activity watermarks and kill us.
+        deadline = time.monotonic() + WEDGE_SLEEP_S
+        while time.monotonic() < deadline:
+            time.sleep(0.5)

@@ -1,0 +1,56 @@
+"""Cross-topology resume: restore a checkpoint onto whatever mesh exists.
+
+``test_checkpoint_cross_topology.py`` proved the mechanism (Orbax's
+restore-into-sharded-target reshards automatically); this module makes
+it a supported path instead of test folklore. ``elastic_restore`` shards
+a freshly initialized template state onto the CURRENT mesh under the
+CURRENT rules, restores the newest (or a chosen) checkpoint into that
+target — values from disk, layout from today's hardware — and records a
+flight ``resume`` event that says whether the topology changed and from
+what, using the sidecar written by ``CheckpointManager.save(...,
+topology=...)``.
+
+Optimizer state rides along for free: ``shard_state`` mirrors param
+shardings onto param-shaped optimizer moments (adam mu/nu), so the
+restored moments are bitwise the saved values, just resharded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from jax.sharding import Mesh
+
+from ..core.checkpoint import CheckpointManager
+from ..obs import flight
+from ..parallel.sharding import Rules
+from ..train.steps import shard_state
+from . import topology as topo
+
+__all__ = ["elastic_restore"]
+
+
+def elastic_restore(ckpt: CheckpointManager, state: Any, mesh: Mesh,
+                    rules: Optional[Rules] = None,
+                    step: Optional[int] = None) -> Tuple[Any, int]:
+    """Restore the newest checkpoint onto ``mesh`` — re-sharding as
+    needed — and return ``(state, step)``.
+
+    ``state`` is a template (freshly initialized, correct structure);
+    its values are discarded when a checkpoint exists. With no
+    checkpoint, returns the template sharded onto the mesh at step 0 —
+    i.e. calling this unconditionally at startup is the whole resume
+    policy."""
+    target = shard_state(state, mesh, rules)
+    step = ckpt.latest_step() if step is None else step
+    if step is None:
+        return target, 0
+    saved_topo = ckpt.topology(step)
+    current = topo.current_topology(mesh)
+    cross = topo.topology_changed(saved_topo, current)
+    restored = ckpt.restore(target, step)
+    flight.record(
+        "resume", step=int(step), cross_topology=bool(cross),
+        saved_topology=topo.topology_str(saved_topo),
+        current_topology=topo.topology_str(current))
+    return restored, int(step)

@@ -1,0 +1,89 @@
+"""Topology fingerprints: what hardware a checkpoint was written on.
+
+A checkpoint that will be resumed on *whatever capacity the scheduler
+gives back* must record what it was sharded over, so the resume path can
+(a) decide whether this is a same-topology fast path or a cross-topology
+reshard, and (b) leave an auditable flight event saying which. The
+fingerprint is a small JSON dict — mesh axis sizes, device/process
+counts, platform, and the shard-layout summary of the saved state — that
+``CheckpointManager.save(..., topology=...)`` drops next to each step.
+
+This module imports jax; keep it out of ``elastic/__init__`` so the
+supervisor process can import the package without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..parallel.mesh import mesh_shape_str
+from ..parallel.sharding import shard_layout_summary
+
+__all__ = ["current_topology", "topology_changed", "topology_str"]
+
+
+def _mesh_from_state(state: Any) -> Optional[Mesh]:
+    """The mesh a placed pytree lives on, read off its first
+    NamedSharding leaf (the Trainer holds a state, not a mesh)."""
+    try:
+        for leaf in jax.tree.leaves(state):
+            mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+            if mesh is not None and hasattr(mesh, "shape"):
+                return mesh
+    except Exception:  # noqa: BLE001 - inference is best-effort
+        pass
+    return None
+
+
+def current_topology(mesh: Optional[Mesh] = None,
+                     state: Optional[Any] = None) -> Dict[str, Any]:
+    """Fingerprint the running process: device/process counts, platform,
+    the mesh axis sizes (given a mesh, or inferred from ``state``'s
+    shardings), and the state's shard layout (when given)."""
+    devices = jax.devices()
+    doc: Dict[str, Any] = {
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+        "platform": devices[0].platform if devices else "none",
+    }
+    if mesh is None and state is not None:
+        mesh = _mesh_from_state(state)
+    if mesh is not None:
+        doc["mesh_shape"] = {str(k): int(v) for k, v in mesh.shape.items()}
+        doc["mesh_str"] = mesh_shape_str(mesh)
+    if state is not None:
+        try:
+            doc["shard_layout"] = shard_layout_summary(state)
+        except Exception:  # noqa: BLE001 - a summary failure must not
+            pass           # block the checkpoint that embeds it
+    return doc
+
+
+def topology_changed(saved: Optional[Dict[str, Any]],
+                     current: Dict[str, Any]) -> bool:
+    """True when resume-time hardware differs from save-time in any way
+    that forces a reshard: device count, process count, or mesh axis
+    sizes. Unknown saved topology (old checkpoint, missing sidecar)
+    counts as changed — the reshard path is always safe, the fast
+    assumption is not."""
+    if not saved:
+        return True
+    for key in ("device_count", "process_count"):
+        if saved.get(key) != current.get(key):
+            return True
+    a, b = saved.get("mesh_shape"), current.get("mesh_shape")
+    if a is not None and b is not None and dict(a) != dict(b):
+        return True
+    return False
+
+
+def topology_str(doc: Optional[Dict[str, Any]]) -> str:
+    if not doc:
+        return "unknown"
+    mesh = doc.get("mesh_str") or "?"
+    return (f"{mesh} ({doc.get('device_count', '?')} devices, "
+            f"{doc.get('process_count', '?')} processes, "
+            f"{doc.get('platform', '?')})")

@@ -102,10 +102,16 @@ class FlightRecorder:
 
     def dump(self, reason: str = "manual", *,
              exception: Optional[BaseException] = None,
-             path: Optional[str] = None) -> Optional[str]:
+             path: Optional[str] = None,
+             include_hbm: bool = True) -> Optional[str]:
         """Write ``flightrec.json``; returns the path (None when no path
         is configured — recording without arming is legal). Never raises:
-        this runs inside except blocks and signal handlers."""
+        this runs inside except blocks and signal handlers.
+
+        ``include_hbm=False`` skips the device-memory snapshot — the run
+        supervisor uses it because ``hbm_snapshot`` initializes the jax
+        backend, and a supervisor must not wedge in the same device init
+        it polices."""
         try:
             path = path or self.path
             if not path:
@@ -119,14 +125,17 @@ class FlightRecorder:
                         type(exception), exception,
                         exception.__traceback__),
                 }
-            from .xla import hbm_snapshot   # lazy: avoid import cycle
+            hbm = None
+            if include_hbm:
+                from .xla import hbm_snapshot   # lazy: avoid import cycle
+                hbm = _jsonable(hbm_snapshot())
             doc = {
                 "reason": reason,
                 "time": time.time(),
                 "pid": os.getpid(),
                 "config": self.config,
                 "exception": exc_info,
-                "hbm": _jsonable(hbm_snapshot()),
+                "hbm": hbm,
                 "events": _jsonable(self.events()),
             }
             os.makedirs(os.path.dirname(os.path.abspath(path)),
@@ -163,29 +172,23 @@ def dump(reason: str = "manual", *,
     return _RECORDER.dump(reason, exception=exception, path=path)
 
 
+def _sigterm_dump(signum: int, frame) -> None:
+    _RECORDER.dump("sigterm")
+
+
 def install_signal_handler() -> bool:
-    """Dump on SIGTERM (preemption / driver kill) before the default
-    termination proceeds. Chains any previously-installed handler. Only
-    possible from the main thread; returns False when it isn't."""
+    """Dump on SIGTERM (preemption / driver kill). Subscribes through
+    the elastic signal registry, so this hook COEXISTS with the
+    preemption guard instead of silently replacing it: without a
+    graceful subscriber the process still terminates after the dump
+    (pre-registry handler or OS default chained); with one, the trainer
+    checkpoints and exits at the next step boundary. Main thread only;
+    returns False when it isn't."""
     global _SIGNAL_INSTALLED
     if _SIGNAL_INSTALLED:
         return True
-    if threading.current_thread() is not threading.main_thread():
-        return False
-    try:
-        previous = signal.getsignal(signal.SIGTERM)
-
-        def handler(signum, frame):
-            _RECORDER.dump("sigterm")
-            if callable(previous) and previous not in (
-                    signal.SIG_IGN, signal.SIG_DFL):
-                previous(signum, frame)
-            else:
-                signal.signal(signal.SIGTERM, signal.SIG_DFL)
-                os.kill(os.getpid(), signal.SIGTERM)
-
-        signal.signal(signal.SIGTERM, handler)
+    from ..elastic import signals      # lazy: flight must import light
+    if signals.subscribe(signal.SIGTERM, _sigterm_dump):
         _SIGNAL_INSTALLED = True
         return True
-    except (ValueError, OSError):      # non-main thread / exotic runtime
-        return False
+    return False

@@ -29,7 +29,8 @@ from typing import Any, Dict, List, Optional
 from . import flight, spans
 
 __all__ = ["tracked_compile", "compile_events", "compile_stats",
-           "memory_analysis_dict", "hbm_snapshot", "HbmWatermark"]
+           "memory_analysis_dict", "hbm_snapshot", "HbmWatermark",
+           "set_hbm_alert_frac"]
 
 # bounded ring of compile-event dicts (module-wide: compiles are rare
 # and the ring is the natural join point for /stats and obs_report)
@@ -147,27 +148,94 @@ def clear_compile_events() -> None:
 
 
 # ------------------------------------------------------------- memory
-def hbm_snapshot() -> Dict[str, Any]:
+# ROADMAP calibration-debt note: memory_stats() field sets vary by
+# device generation (v4 lacks some of what v5 reports, CPU reports
+# nothing), so every field is individually optional and individually
+# int-converted — one odd field must not drop the whole entry.
+_HBM_FIELDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+               "largest_alloc_size", "bytes_reserved",
+               "pool_bytes", "num_allocs")
+
+# alert when bytes_in_use crosses this fraction of bytes_limit (None =
+# off). Process-wide because hbm_snapshot is called from crash dumps and
+# sampler threads that have no config handle.
+_ALERT_FRAC: Optional[float] = None
+_ALERTED: set = set()          # device ids already alerted (edge-trigger)
+
+
+def set_hbm_alert_frac(frac: Optional[float]) -> Optional[float]:
+    """Configure (or disable, with None) the HBM usage alert threshold;
+    returns the previous value. The Trainer wires its ``hbm_alert_frac``
+    kwarg here; ``DLTPU_HBM_ALERT_FRAC`` seeds it for bare scripts."""
+    global _ALERT_FRAC
+    previous = _ALERT_FRAC
+    _ALERT_FRAC = None if frac is None else float(frac)
+    _ALERTED.clear()
+    return previous
+
+
+def _env_alert_frac() -> Optional[float]:
+    import os
+    raw = os.environ.get("DLTPU_HBM_ALERT_FRAC")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _mem_entry(dev, stats, alert_frac: Optional[float]) -> Dict[str, Any]:
+    """One device's snapshot entry from a raw memory_stats() dict, with
+    per-field guards and the optional usage-fraction alert."""
+    entry: Dict[str, Any] = {"id": dev.id,
+                             "kind": getattr(dev, "device_kind", "")}
+    if not stats:
+        return entry
+    for key in _HBM_FIELDS:
+        if key in stats:
+            try:
+                entry[key] = int(stats[key])
+            except (TypeError, ValueError):
+                pass           # generation reports a non-numeric field
+    in_use, limit = entry.get("bytes_in_use"), entry.get("bytes_limit")
+    if in_use is not None and limit:
+        frac = in_use / limit
+        entry["usage_frac"] = round(frac, 4)
+        if alert_frac is not None and frac >= alert_frac:
+            entry["alert"] = {"threshold_frac": alert_frac,
+                              "usage_frac": round(frac, 4)}
+            if dev.id not in _ALERTED:     # edge-trigger: once per device
+                _ALERTED.add(dev.id)
+                flight.record("hbm_alert", device=dev.id,
+                              usage_frac=round(frac, 4),
+                              threshold_frac=alert_frac,
+                              bytes_in_use=in_use, bytes_limit=limit)
+        elif alert_frac is not None:
+            _ALERTED.discard(dev.id)       # re-arm once usage recedes
+    return entry
+
+
+def hbm_snapshot(alert_frac: Optional[float] = None) -> Dict[str, Any]:
     """One point-in-time device-memory reading; cheap enough to take at
-    crash time and from the sampler thread. Fields that a backend does
-    not report are simply absent."""
+    crash time and from the sampler thread. Fields that a backend or
+    device generation does not report are simply absent. When an alert
+    fraction is configured (argument > ``set_hbm_alert_frac`` >
+    ``DLTPU_HBM_ALERT_FRAC``), a device crossing it gets an ``alert``
+    sub-dict and an edge-triggered ``hbm_alert`` flight event."""
+    if alert_frac is None:
+        alert_frac = _ALERT_FRAC if _ALERT_FRAC is not None \
+            else _env_alert_frac()
     snap: Dict[str, Any] = {"time": time.time()}
     try:
         import jax
         devices = []
         for d in jax.devices():
-            entry: Dict[str, Any] = {"id": d.id,
-                                     "kind": getattr(d, "device_kind", "")}
             try:
                 stats = d.memory_stats()
             except Exception:  # noqa: BLE001 - CPU backends raise/None
                 stats = None
-            if stats:
-                for key in ("bytes_in_use", "peak_bytes_in_use",
-                            "bytes_limit", "largest_alloc_size"):
-                    if key in stats:
-                        entry[key] = int(stats[key])
-            devices.append(entry)
+            devices.append(_mem_entry(d, stats, alert_frac))
         snap["devices"] = devices
         arrs = jax.live_arrays()
         snap["live_arrays"] = {
@@ -188,8 +256,10 @@ class HbmWatermark:
     An immediate first sample on ``start()`` guarantees even a 5-step
     smoke run records at least one memory point."""
 
-    def __init__(self, interval_s: float = 0.5):
+    def __init__(self, interval_s: float = 0.5,
+                 alert_frac: Optional[float] = None):
         self.interval_s = max(float(interval_s), 0.01)
+        self.alert_frac = alert_frac
         self.samples = 0
         self.peak_live_bytes = 0
         self.peak_bytes_in_use = 0
@@ -198,7 +268,7 @@ class HbmWatermark:
 
     def _sample(self) -> None:
         t0 = time.perf_counter()
-        snap = hbm_snapshot()
+        snap = hbm_snapshot(alert_frac=self.alert_frac)
         self.samples += 1
         live = snap.get("live_arrays", {}).get("nbytes", 0)
         self.peak_live_bytes = max(self.peak_live_bytes, live)

@@ -10,7 +10,7 @@ the analog of DDP's bucketed NCCL all-reduce, but fused by the compiler.
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -113,6 +113,30 @@ FSDP_RULES: Rules = (
     (r"kernel$", P(None, None, None, FSDP_AXIS)),
     (r"kernel$", P(None, FSDP_AXIS)),
 )
+
+
+def shard_layout_summary(tree: Any) -> Dict[str, Any]:
+    """Compact JSON-able description of how a pytree is laid out: the
+    PartitionSpec of every NON-replicated jax.Array leaf (keyed by
+    '/'-joined path) plus leaf counts. This is what checkpoint topology
+    sidecars embed so a resume can report what layout it came from —
+    listing only the sharded leaves keeps a pure-DP summary tiny."""
+    paths = tree_paths(tree)
+    specs: Dict[str, str] = {}
+    counts = {"leaves": 0, "replicated": 0, "sharded": 0}
+
+    def visit(path: str, leaf: Any) -> None:
+        counts["leaves"] += 1
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if sharding is None or spec is None or sharding.is_fully_replicated:
+            counts["replicated"] += 1
+            return
+        counts["sharded"] += 1
+        specs[path] = str(tuple(spec))
+
+    jax.tree.map(visit, paths, tree)
+    return {"specs": specs, **counts}
 
 
 def host_local_slice(global_batch: int) -> Tuple[int, int]:

@@ -65,6 +65,14 @@ class TrainState(flax.struct.PyTreeNode):
             ema_params=new_ema,
         )
 
+    def shard_summary(self) -> dict:
+        """JSON-able layout description (which leaves are sharded, how)
+        — embedded in checkpoint topology sidecars so a cross-topology
+        resume can report the layout it is resharding FROM."""
+        from ..parallel.sharding import shard_layout_summary
+        return shard_layout_summary(
+            {"params": self.params, "opt_state": self.opt_state})
+
     @property
     def eval_params(self) -> Any:
         return self.ema_params if self.ema_params is not None else self.params

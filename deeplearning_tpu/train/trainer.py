@@ -29,6 +29,9 @@ from ..core.logging import (LoggerHub, MetricLogger,
                             TensorBoardWriter, create_logger,
                             is_main_process)
 from ..data.device_prefetch import DevicePrefetcher
+from ..elastic import faults
+from ..elastic import heartbeat as hb
+from ..elastic.preempt import Preempted, PreemptionGuard
 from ..obs import flight
 from ..obs.spans import span, step_span
 from ..utils.profiling import RetraceGuard
@@ -81,8 +84,23 @@ class Trainer:
         obs="auto",
         run_config: Optional[Dict] = None,
         hbm_sample_s: float = 0.25,
+        hbm_alert_frac: Optional[float] = None,
+        preemptible: bool = True,
+        heartbeat="auto",
     ):
         self.state = state
+        # elastic-run wiring (README "Elastic run policy"): preemptible
+        # installs the chained SIGTERM/SIGINT guard (flush checkpoint →
+        # Preempted at the next step boundary → exit 75); heartbeat
+        # "auto" writes the supervisor's step/activity watermark file
+        # when DLTPU_HEARTBEAT names one (a path forces it, False/None
+        # disables).
+        self.preemptible = bool(preemptible)
+        self._heartbeat_opt = heartbeat
+        self.preempt_guard: Optional[PreemptionGuard] = None
+        self._beat: Optional[hb.Heartbeat] = None
+        self._beat_writer: Optional[hb.HeartbeatWriter] = None
+        self.hbm_alert_frac = hbm_alert_frac
         # observability (README "Observability policy"): spans + flight
         # recorder + HBM sampler. "auto" = on whenever the run has a
         # workdir to dump trace.json/flightrec.json into; True forces it
@@ -241,7 +259,8 @@ class Trainer:
             flight.configure(os.path.join(self.workdir, "flightrec.json"),
                              config=self._obs_config())
             flight.install_signal_handler()
-        self._hbm = HbmWatermark(interval_s=self.hbm_sample_s).start()
+        self._hbm = HbmWatermark(interval_s=self.hbm_sample_s,
+                                 alert_frac=self.hbm_alert_frac).start()
 
     def _obs_finish(self) -> None:
         if not self.obs_enabled:
@@ -257,11 +276,71 @@ class Trainer:
             spans.disable()
         self._obs_started = False      # a second train() re-arms
 
+    # ---------------------------------------------------------- elastic
+    def _elastic_start(self) -> None:
+        """Arm the preemption guard and the heartbeat writer. Idempotent
+        like ``_obs_start`` (train() may be called twice)."""
+        if self.preemptible and self.preempt_guard is None:
+            guard = PreemptionGuard()
+            if self.ckpt:
+                # in-handler flush: the in-flight async write commits
+                # even if the loop never reaches another step boundary
+                guard.add_flush(self.ckpt.flush)
+            if guard.install():
+                self.preempt_guard = guard
+        if self._beat_writer is None:
+            path = self._heartbeat_opt
+            if path == "auto":
+                path = os.environ.get(hb.ENV_VAR)
+            if path:
+                self._beat = hb.Heartbeat(step=self.host_step)
+                self._beat_writer = hb.HeartbeatWriter(
+                    str(path), self._beat).start()
+
+    def _elastic_finish(self) -> None:
+        if self._beat_writer is not None:
+            self._beat_writer.stop()
+            self._beat_writer = None
+        if self.preempt_guard is not None:
+            self.preempt_guard.uninstall()
+            self.preempt_guard = None
+
+    def _beat_touch(self, phase: str) -> None:
+        if self._beat is not None:
+            self._beat.touch(phase, step=self.host_step)
+
+    def _check_preempted(self) -> None:
+        """Step-boundary poll (one Event.is_set when armed)."""
+        if self.preempt_guard is not None and \
+                self.preempt_guard.requested():
+            raise Preempted(
+                f"preemption signal at step {self.host_step}",
+                signum=self.preempt_guard.signum, step=self.host_step)
+
+    def _on_preempted(self, exc: Preempted) -> None:
+        """Land the final state: checkpoint the interrupted step (unless
+        a periodic save already wrote it), barrier the write, dump the
+        flight ring with the distinct 'preempted' reason."""
+        if self.ckpt:
+            step = int(self.state.step)     # sync is fine — we're dying
+            if self.ckpt.latest_step() != step:
+                self._save()
+            self.ckpt.flush()
+            self.logger.info(
+                f"preempted (signal {exc.signum}): checkpoint flushed at "
+                f"step {step}; exit with EXIT_PREEMPTED requeues")
+        if self.obs_enabled:
+            flight.dump("preempted", exception=exc)
+
     # ------------------------------------------------------------- train
     def train(self) -> Any:
         self._obs_start()
+        self._elastic_start()
         try:
             return self._train()
+        except Preempted as exc:
+            self._on_preempted(exc)
+            raise
         except BaseException as exc:
             if self.obs_enabled:
                 reason = ("divergence"
@@ -270,6 +349,7 @@ class Trainer:
                 flight.dump(reason, exception=exc)
             raise
         finally:
+            self._elastic_finish()
             self._obs_finish()
 
     def _train(self) -> Any:
@@ -352,6 +432,13 @@ class Trainer:
             if it % self.log_every == 0:
                 with span("metrics_flush"):
                     self._consume(self.deferred.poll())
+            # elastic step boundary: advance the heartbeat watermark,
+            # give the fault harness its mid-step hook (a sigterm fault
+            # routes through the real kernel-delivered handler chain),
+            # then land any requested preemption while state is clean
+            self._beat_touch("step")
+            faults.maybe_fire("step", step=self.host_step)
+            self._check_preempted()
             t_data = time.time()
             it += 1
         # epoch-end barrier: one bulk fetch lands every remaining entry,
@@ -420,11 +507,13 @@ class Trainer:
         the loop runs (dispatch only), then ONE ``jax.device_get`` lands
         the whole list. Host-side accumulation order matches the old
         per-batch-float path exactly, so totals are bitwise identical."""
+        self._beat_touch("eval")
         with span("eval", epoch=self.epoch):
             per_batch = [self.eval_step(self.state, batch)
                          for batch in self.eval_loader]
             # the one materialization
             host_counts = jax.device_get(per_batch)
+        self._beat_touch("eval")
         self.eval_fetches += 1
         totals: Dict[str, float] = defaultdict(float)
         for counts in host_counts:
@@ -452,11 +541,23 @@ class Trainer:
 
     def _save(self, is_best: bool = False) -> None:
         step = int(self.state.step)
+        self._beat_touch("checkpoint")
+        faults.maybe_fire("checkpoint", step=step)
         with span("checkpoint", step=step, best=is_best):
             self.ckpt.save(step, self.state,
                            metrics={self.best_metric: self.best_value},
-                           is_best=is_best)
+                           is_best=is_best,
+                           topology=self._topology())
         self.callbacks.fire("on_checkpoint", self, step=step)
+
+    def _topology(self) -> Optional[Dict[str, Any]]:
+        """Topology fingerprint for the checkpoint sidecar — what a
+        cross-topology resume reports it is re-sharding FROM."""
+        try:
+            from ..elastic.topology import current_topology
+            return current_topology(state=self.state)
+        except Exception:  # noqa: BLE001 - never block a save on it
+            return None
 
     # -------------------------------------------------- throughput mode
     def throughput(self, n_iters: int = 30, lag: int = 3) -> float:

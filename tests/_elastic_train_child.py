@@ -1,0 +1,110 @@
+"""Training child launched by the supervisor e2e test (test_elastic.py).
+
+Runs a tiny mnist_fcn classification job under the full elastic stack:
+the mesh is chosen by the restart attempt (attempt 0 -> pure data
+parallel, attempt >= 1 -> DP x TP, so any resume after the first launch
+is a cross-topology resume), faults come from ``DLTPU_FAULTS``, the
+heartbeat path from ``DLTPU_HEARTBEAT``, and a preemption signal turns
+into exit code 75 exactly as in tools/train.py. One record per attempt
+is appended to ``<workdir>/progress.jsonl`` so the test can assert step
+continuity across restarts.
+
+Usage: python tests/_elastic_train_child.py <workdir> [epochs]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Same forcing as tests/conftest.py: XLA_FLAGS is read at backend init
+# (which has not happened yet), the platform must go through jax.config.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.data import ArraySource, DataLoader
+    from deeplearning_tpu.elastic import EXIT_PREEMPTED, Preempted
+    from deeplearning_tpu.elastic.faults import current_attempt
+    from deeplearning_tpu.parallel import MeshConfig, build_mesh
+    from deeplearning_tpu.parallel.mesh import mesh_shape_str
+    from deeplearning_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+    from deeplearning_tpu.train import TrainState, make_train_step
+    from deeplearning_tpu.train.classification import (make_loss_fn,
+                                                       make_metric_fn)
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+    from deeplearning_tpu.train.steps import make_eval_step, shard_state
+    from deeplearning_tpu.train.trainer import Trainer
+
+    attempt = current_attempt()
+    if attempt == 0:
+        mesh = build_mesh(MeshConfig(data=-1))
+        rules = None
+    else:
+        mesh = build_mesh(MeshConfig(data=-1, model=2))
+        rules = TRANSFORMER_TP_RULES
+
+    rng = np.random.default_rng(0)
+    n, batch = 96, 16
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    images = rng.normal(0, 0.1, (n, 16, 16, 1)).astype(np.float32)
+    for i, lab in enumerate(labels):
+        images[i, :, lab * 4:(lab + 1) * 4, 0] += 2.0
+
+    model = MODELS.build("mnist_fcn", num_classes=4, dtype=jnp.float32)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 16, 16, 1)))["params"]
+    tx = build_optimizer(
+        "sgd", build_schedule("constant", base_lr=0.1), params=params)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = shard_state(state, mesh, rules)
+
+    trainer = Trainer(
+        state=state,
+        train_step=make_train_step(make_loss_fn(), donate=False, mesh=mesh),
+        train_loader=DataLoader(ArraySource(image=images, label=labels),
+                                global_batch=batch, seed=0),
+        eval_step=make_eval_step(make_metric_fn(ks=(1,)), mesh=mesh),
+        eval_loader=DataLoader(ArraySource(image=images, label=labels),
+                               global_batch=batch, shuffle=False),
+        epochs=epochs, log_every=100, workdir=workdir,
+        async_checkpoint=True, save_every_epochs=1,
+        log_backends=("jsonl",), obs=True,
+    )
+
+    start_step = trainer.ckpt.latest_step() or 0
+
+    def progress(outcome: str) -> None:
+        rec = {"attempt": attempt, "start_step": int(start_step),
+               "final_step": int(trainer.state.step),
+               "mesh": mesh_shape_str(mesh), "outcome": outcome}
+        with open(os.path.join(workdir, "progress.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    try:
+        trainer.train()
+    except Preempted:
+        progress("preempted")
+        return EXIT_PREEMPTED
+    progress("completed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

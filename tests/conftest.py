@@ -18,3 +18,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run subprocess-spawning e2e tests after everything else: each
+    child process re-imports jax and recompiles its step from scratch,
+    making them the priciest items in the suite — fast unit feedback
+    should not queue behind them under a tight CI time budget."""
+    tail = [it for it in items if it.get_closest_marker("e2e")]
+    if tail:
+        tail_set = set(tail)
+        items[:] = [it for it in items if it not in tail_set] + tail

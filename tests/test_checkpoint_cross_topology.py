@@ -81,6 +81,69 @@ class TestCrossTopologyRestore:
         assert np.isfinite(float(metrics["loss"]))
         assert int(new_state.step) == prev_step + 1
 
+    @pytest.mark.e2e
+    def test_optimizer_state_survives_mesh_change(self, tmp_path):
+        """The elastic resume path (elastic.resume.elastic_restore): a
+        trained state — adam mu/nu populated, not zeros — saved on DP8
+        with its topology fingerprint comes back bitwise-identical on
+        DP4×TP2, with the moments re-sharded alongside the params and a
+        cross-topology resume flight event on the record."""
+        from deeplearning_tpu.elastic.resume import elastic_restore
+        from deeplearning_tpu.elastic.topology import current_topology
+        from deeplearning_tpu.obs import flight
+        from deeplearning_tpu.parallel.sharding import batch_sharding
+        from deeplearning_tpu.train import make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+
+        mesh_dp = build_mesh(MeshConfig(data=-1))            # DP8
+        state = shard_state(_state(0), mesh_dp)
+        step_fn = make_train_step(make_loss_fn(), mesh=mesh_dp)
+        g = np.random.default_rng(0)
+        batch = {"image": jnp.asarray(g.normal(size=(8, 16, 16, 3)),
+                                      jnp.float32),
+                 "label": jnp.asarray(g.integers(0, 4, 8), jnp.int32)}
+        batch = jax.device_put(batch, batch_sharding(mesh_dp))
+        state, _ = step_fn(state, batch, jax.random.key(0))
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, state, topology=current_topology(mesh_dp, state))
+        mgr.wait_until_finished()
+        saved_opt = jax.device_get(state.opt_state)
+        saved_params = jax.device_get(state.params)
+
+        mesh_tp = build_mesh(MeshConfig(data=-1, model=2))   # DP4×TP2
+        n_before = len(flight.get_recorder().events("resume"))
+        restored, step = elastic_restore(
+            CheckpointManager(str(tmp_path)), _state(1), mesh_tp,
+            rules=TRANSFORMER_TP_RULES)
+        assert step == 1 and int(restored.step) == 1
+
+        # bitwise equality modulo re-sharding, moments included
+        _leaves_equal(restored.params, saved_params)
+        _leaves_equal(restored.opt_state, saved_opt)
+        # trained moments are non-trivial (the test would pass vacuously
+        # against freshly-initialized zeros otherwise)
+        mu = jax.tree.leaves(restored.opt_state)
+        assert any(float(np.abs(np.asarray(leaf)).max()) > 0
+                   for leaf in mu if hasattr(leaf, "shape") and
+                   getattr(leaf, "size", 0) > 1)
+        # moments follow the params onto the TP layout
+        qkv = restored.params["blocks_0"]["attn"]["qkv"]["kernel"]
+        assert qkv.sharding.mesh.shape["model"] == 2
+        assert not qkv.sharding.is_fully_replicated
+        opt_sharded = [leaf for leaf in mu
+                       if hasattr(leaf, "sharding")
+                       and not leaf.sharding.is_fully_replicated]
+        assert opt_sharded, "adam moments stayed fully replicated"
+
+        # the resume is on the flight record, flagged cross-topology
+        events = flight.get_recorder().events("resume")
+        assert len(events) == n_before + 1
+        assert events[-1]["cross_topology"] is True
+        assert events[-1]["step"] == 1
+        assert "data=8" in events[-1]["saved_topology"]
+        assert "model=2" in events[-1]["current_topology"]
+
     def test_dp8_restores_onto_pipeline_mesh(self, tmp_path):
         from deeplearning_tpu.parallel.pipeline_train import (
             shard_pipeline_state, split_vit_params)

@@ -1,0 +1,425 @@
+"""Elastic runs: chained signals, fault injection, heartbeat, wedge
+detection, backoff, the HBM usage alert, and the supervisor's
+kill-and-resume invariant (ISSUE 6 acceptance) end-to-end over a real
+subprocess child."""
+
+import importlib.util
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from deeplearning_tpu.elastic import (EXIT_PREEMPTED, Preempted,
+                                      PreemptionGuard, Supervisor,
+                                      SupervisorConfig, WedgeDetector,
+                                      faults, signals)
+from deeplearning_tpu.elastic.heartbeat import (Heartbeat, HeartbeatWriter,
+                                                read_heartbeat)
+from deeplearning_tpu.elastic.supervisor import backoff_delay
+from deeplearning_tpu.obs import flight
+
+# Deferred to the tail of the run (conftest e2e reordering): this file
+# spawns full training subprocesses — each child re-imports jax and
+# recompiles — making it the priciest module in the suite.
+pytestmark = pytest.mark.e2e
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(ROOT, "tests", "_elastic_train_child.py")
+
+
+def _deliver(signum):
+    os.kill(os.getpid(), signum)
+    time.sleep(0.01)               # let a (rare) deferred delivery land
+
+
+# --------------------------------------------------------------- signals
+class TestSignalChaining:
+    """SIGUSR1 stands in for SIGTERM: same registry code path, no risk
+    of killing the test process on a chained default."""
+
+    def test_chain_then_graceful_owner(self):
+        calls = []
+        prev = signal.signal(signal.SIGUSR1,
+                             lambda s, f: calls.append("prev"))
+        sub_a = lambda s, f: calls.append("a")          # noqa: E731
+        sub_g = lambda s, f: calls.append("graceful")   # noqa: E731
+        try:
+            assert signals.subscribe(signal.SIGUSR1, sub_a)
+            assert signals.installed(signal.SIGUSR1)
+            _deliver(signal.SIGUSR1)
+            # non-graceful subscriber runs, then chains the pre-registry
+            # handler — the flight-recorder-only process dies as before
+            assert calls == ["a", "prev"]
+
+            calls.clear()
+            assert signals.subscribe(signal.SIGUSR1, sub_g, graceful=True)
+            _deliver(signal.SIGUSR1)
+            # a graceful owner suppresses the chain: everyone still runs,
+            # the terminating previous handler does not
+            assert calls == ["a", "graceful"]
+        finally:
+            # leave the dispatcher installed (removing it races with
+            # delivery — signals.py's own rule); just drop subscribers
+            signals.unsubscribe(signal.SIGUSR1, sub_a)
+            signals.unsubscribe(signal.SIGUSR1, sub_g)
+        assert signals.subscribers(signal.SIGUSR1) == []
+
+    def test_failing_subscriber_never_starves_the_rest(self):
+        calls = []
+
+        def bad(s, f):
+            raise RuntimeError("boom")
+
+        ok = lambda s, f: calls.append("ok")            # noqa: E731
+        graceful = lambda s, f: None                    # noqa: E731
+        assert signals.subscribe(signal.SIGUSR1, bad)
+        assert signals.subscribe(signal.SIGUSR1, ok)
+        assert signals.subscribe(signal.SIGUSR1, graceful, graceful=True)
+        try:
+            _deliver(signal.SIGUSR1)
+            assert calls == ["ok"]
+        finally:
+            signals.unsubscribe(signal.SIGUSR1, bad)
+            signals.unsubscribe(signal.SIGUSR1, ok)
+            signals.unsubscribe(signal.SIGUSR1, graceful)
+
+
+class TestPreemptionGuard:
+    def test_signal_flushes_and_flags(self):
+        flushed = []
+        guard = PreemptionGuard(signums=(signal.SIGUSR2,))
+        guard.add_flush(lambda: flushed.append(1))
+        assert guard.install()
+        try:
+            before = len(flight.get_recorder().events("preempt_signal"))
+            _deliver(signal.SIGUSR2)
+            assert guard.requested()
+            assert guard.signum == signal.SIGUSR2
+            assert flushed == [1]
+            after = flight.get_recorder().events("preempt_signal")
+            assert len(after) == before + 1
+            # double delivery: already landing, flush not re-run
+            _deliver(signal.SIGUSR2)
+            assert flushed == [1]
+        finally:
+            guard.uninstall()
+        assert signals.subscribers(signal.SIGUSR2) == []
+
+    def test_programmatic_request(self):
+        guard = PreemptionGuard(signums=())
+        assert not guard.requested()
+        guard.request()
+        assert guard.requested()
+
+
+# ---------------------------------------------------------------- faults
+class TestFaultGrammar:
+    def test_parse(self):
+        specs = faults.parse_faults(
+            "sigterm@step:5@attempt:0; crash@checkpoint ;wedge@step:3;"
+            "bogus@step;crash@nonsense:2;sigint;;crash@step:xyz")
+        assert [(s.kind, s.site, s.at_step, s.attempt) for s in specs] == [
+            ("sigterm", "step", 5, 0),
+            ("crash", "checkpoint", None, None),
+            ("wedge", "step", 3, None),
+            ("sigint", "step", None, None),
+        ]
+
+    def test_matches_step_attempt_and_once(self):
+        spec = faults.parse_faults("crash@step:5@attempt:1")[0]
+        assert not spec.matches("step", 4, 1)      # before threshold
+        assert not spec.matches("step", 5, 0)      # wrong attempt
+        assert not spec.matches("checkpoint", 5, 1)  # wrong site
+        assert spec.matches("step", 7, 1)          # at_step is a floor
+        spec.fired = True
+        assert not spec.matches("step", 7, 1)      # at most once
+
+    def test_maybe_fire_crash(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "crash@checkpoint:2")
+        monkeypatch.delenv(faults.ATTEMPT_VAR, raising=False)
+        faults.reset()
+        try:
+            faults.maybe_fire("step", step=10)         # wrong site
+            faults.maybe_fire("checkpoint", step=1)    # below floor
+            with pytest.raises(faults.InjectedCrash):
+                faults.maybe_fire("checkpoint", step=2)
+            faults.maybe_fire("checkpoint", step=3)    # fired once only
+        finally:
+            faults.reset()                 # forget the patched env
+
+    def test_empty_env_is_free(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.reset()
+        try:
+            faults.maybe_fire("step", step=0)
+        finally:
+            faults.reset()
+
+
+# ------------------------------------------------------------- heartbeat
+class TestHeartbeat:
+    def test_touch_semantics(self):
+        beat = Heartbeat(step=3)
+        beat.touch("eval")
+        assert (beat.step, beat.activity, beat.phase) == (3, 1, "eval")
+        beat.touch("step", step=4)
+        assert (beat.step, beat.activity, beat.phase) == (4, 2, "step")
+
+    def test_writer_roundtrip(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        beat = Heartbeat()
+        writer = HeartbeatWriter(path, beat, interval_s=0.05).start()
+        deadline = time.monotonic() + 5.0
+        doc = None
+        while time.monotonic() < deadline:
+            doc = read_heartbeat(path)
+            if doc is not None:
+                break
+            time.sleep(0.01)
+        assert doc is not None and doc["pid"] == os.getpid()
+        beat.touch("step", step=9)
+        writer.stop()                      # final write = exit watermark
+        doc = read_heartbeat(path)
+        assert doc["step"] == 9 and doc["activity"] == 1
+
+    def test_read_absent_and_torn(self, tmp_path):
+        assert read_heartbeat(str(tmp_path / "missing.json")) is None
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"step": 3, "activ')
+        assert read_heartbeat(str(torn)) is None
+
+
+# -------------------------------------------------------- wedge detector
+class TestWedgeDetector:
+    def test_slow_vs_wedged_classification(self):
+        det = WedgeDetector(10.0)
+        assert det.observe(0, 0, now=1000.0) == "ok"
+        # activity ticking, step frozen: a long compile is SLOW, not dead
+        assert det.observe(0, 1, now=1005.0) == "slow"
+        assert det.observe(0, 2, now=1012.0) == "slow"
+        assert det.observe(0, 2, now=1021.9) == "slow"   # 9.9s < deadline
+        assert det.observe(0, 2, now=1022.0) == "wedged"
+        assert det.stalled_for(now=1022.0) == pytest.approx(10.0)
+        # any movement re-arms
+        assert det.observe(1, 3, now=1023.0) == "ok"
+        assert det.stalled_for(now=1023.0) == 0.0
+
+    def test_watch_fires_once_after_freeze(self):
+        det = WedgeDetector(0.2)
+        fired = []
+        act = [0]
+        thread = det.watch(lambda: act[0], fired.append, poll_s=0.03)
+        for _ in range(5):                 # healthy: activity advances
+            act[0] += 1
+            time.sleep(0.05)
+        assert fired == []
+        deadline = time.monotonic() + 5.0  # now freeze it
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(fired) == 1 and fired[0] >= 0.2
+        thread.join(2.0)
+        assert not thread.is_alive()       # one-shot: thread exits
+
+    def test_watch_stop_never_fires(self):
+        det = WedgeDetector(0.1)
+        fired = []
+        thread = det.watch(lambda: 0, fired.append, poll_s=0.02)
+        thread.stop.set()
+        thread.join(2.0)
+        assert fired == [] and not thread.is_alive()
+
+
+def test_backoff_bounds():
+    cfg = SupervisorConfig(["x"], backoff_base_s=0.5, backoff_factor=2.0,
+                           backoff_max_s=4.0, backoff_jitter=0.25)
+    rng = random.Random(0)
+    for attempt, lo in [(1, 0.5), (2, 1.0), (3, 2.0), (4, 4.0), (9, 4.0)]:
+        for _ in range(25):
+            d = backoff_delay(attempt, cfg, rng)
+            assert lo <= d <= lo * 1.25 + 1e-9
+
+
+# --------------------------------------------------------- HBM alerting
+class TestHbmAlert:
+    def test_edge_triggered_alert_and_field_guards(self):
+        from deeplearning_tpu.obs import xla
+        dev = types.SimpleNamespace(id=7777, device_kind="fake")
+        prev = xla.set_hbm_alert_frac(0.8)
+        try:
+            hot = {"bytes_in_use": 90, "bytes_limit": 100,
+                   "peak_bytes_in_use": "not-a-number"}
+            n0 = len(flight.get_recorder().events("hbm_alert"))
+            entry = xla._mem_entry(dev, hot, 0.8)
+            assert entry["usage_frac"] == 0.9
+            assert entry["alert"]["threshold_frac"] == 0.8
+            assert "peak_bytes_in_use" not in entry   # bad field dropped
+            events = flight.get_recorder().events("hbm_alert")
+            assert len(events) == n0 + 1
+            # still hot: alert annotation persists, no second event
+            assert "alert" in xla._mem_entry(dev, hot, 0.8)
+            assert len(flight.get_recorder().events("hbm_alert")) == n0 + 1
+            # recede below threshold: re-arms
+            cool = {"bytes_in_use": 10, "bytes_limit": 100}
+            assert "alert" not in xla._mem_entry(dev, cool, 0.8)
+            xla._mem_entry(dev, hot, 0.8)
+            assert len(flight.get_recorder().events("hbm_alert")) == n0 + 2
+        finally:
+            xla.set_hbm_alert_frac(prev)
+
+    def test_missing_fields_are_guarded(self):
+        from deeplearning_tpu.obs import xla
+        dev = types.SimpleNamespace(id=7778, device_kind="fake")
+        assert "usage_frac" not in xla._mem_entry(
+            dev, {"bytes_in_use": 5}, 0.8)             # no limit
+        assert xla._mem_entry(dev, {}, 0.8) == {"id": 7778, "kind": "fake"}
+        snap = xla.hbm_snapshot()          # CPU backend: must not raise
+        assert "time" in snap
+
+
+# ---------------------------------------------------- obs_report section
+def test_obs_report_restart_summary():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(ROOT, "tools", "obs_report.py"))
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+    sup = {"reason": "completed", "events": [
+        {"kind": "launch"}, {"kind": "child_exit", "outcome": "preempted",
+                             "returncode": 75},
+        {"kind": "backoff", "delay_s": 1.5},
+        {"kind": "launch"}, {"kind": "completed"}]}
+    child = {"events": [{"kind": "resume", "step": 7,
+                         "cross_topology": True}]}
+    rs = obs_report.restart_summary(sup, child)
+    assert rs["launches"] == 2 and rs["preemptions"] == 1
+    assert rs["wedge_kills"] == 0 and rs["crashes"] == 0
+    assert rs["backoff_waits"] == 1
+    assert rs["backoff_total_s"] == pytest.approx(1.5)
+    assert rs["final"] == "completed" and not rs["gave_up"]
+    assert rs["resume_steps"] == [7] and rs["cross_topology_resumes"] == 1
+    assert obs_report.restart_summary(None, None) is None
+
+
+# ------------------------------------------- trainer preemption (in-proc)
+class TestTrainerPreemption:
+    def test_request_checkpoints_and_raises(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.reset()
+        from test_async_hotpath import make_trainer
+        cell = {}
+
+        def hook(trainer, **kw):
+            if trainer.host_step >= 2 and trainer.preempt_guard:
+                trainer.preempt_guard.request()
+
+        from deeplearning_tpu.train.trainer import Callbacks
+        callbacks = Callbacks()
+        callbacks.register("after_iter", hook)
+        trainer = make_trainer(epochs=2, n=6 * 16, batch=16,
+                               workdir=str(tmp_path),
+                               async_checkpoint=True, callbacks=callbacks)
+        cell["t"] = trainer
+        with pytest.raises(Preempted) as exc:
+            trainer.train()
+        # the guard flushed + the trainer saved the interrupted step:
+        # nothing past the last checkpoint is lost on requeue
+        step = int(trainer.state.step)
+        assert exc.value.step == step and step >= 2
+        assert trainer.ckpt.latest_step() == step
+        # guard uninstalled on the way out: no graceful owner remains
+        # (the flight recorder's non-graceful subscriber may stay)
+        assert trainer.preempt_guard is None
+        assert not any(g for _, g in signals.subscribers(signal.SIGTERM))
+
+
+# --------------------------------------------------- supervisor e2e runs
+class TestSupervisorE2E:
+    def test_crash_exhausts_budget(self, tmp_path):
+        cfg = SupervisorConfig(
+            [sys.executable, "-c", "import sys; sys.exit(7)"],
+            workdir=str(tmp_path), max_restarts=1,
+            backoff_base_s=0.05, backoff_max_s=0.1, poll_s=0.05,
+            startup_deadline_s=60.0, seed=0)
+        sup = Supervisor(cfg)
+        assert sup.run() == 7
+        assert sup.outcomes == ["crashed", "crashed"]
+        rec = json.load(open(tmp_path / "flightrec_supervisor.json"))
+        assert rec["reason"] == "gave_up"
+        kinds = [e["kind"] for e in rec["events"]]
+        assert kinds.count("launch") == 2
+        assert kinds.count("backoff") == 1
+        assert kinds[-1] == "gave_up"
+
+    def test_kill_resume_wedge_cycle(self, tmp_path):
+        """The acceptance invariant, full stack: attempt 0 (data=8 mesh)
+        is preempted mid-epoch and exits 75 with its checkpoint flushed;
+        attempt 1 resumes cross-topology (data=4 x model=2), then wedges
+        and must be detected and killed within the deadline; attempt 2
+        resumes again and trains to completion. Step continuity: every
+        resume starts exactly at the preempted checkpoint."""
+        env = dict(os.environ)
+        env["DLTPU_FAULTS"] = "sigterm@step:7@attempt:0;wedge@step:9@attempt:1"
+        cfg = SupervisorConfig(
+            [sys.executable, CHILD, str(tmp_path), "3"],
+            workdir=str(tmp_path), max_restarts=4,
+            wedge_deadline_s=8.0, startup_deadline_s=180.0,
+            poll_s=0.05, kill_grace_s=0.5,
+            backoff_base_s=0.1, backoff_factor=2.0, backoff_max_s=0.3,
+            backoff_jitter=0.25, env=env, seed=0)
+        sup = Supervisor(cfg)
+        rc = sup.run()
+        assert rc == 0
+        assert sup.outcomes == ["preempted", "wedged", "completed"]
+        assert sup.launches == 3
+        assert sup.backoff_total_s > 0
+
+        # supervisor decision log
+        rec = json.load(open(tmp_path / "flightrec_supervisor.json"))
+        assert rec["reason"] == "completed"
+        events = rec["events"]
+        kinds = [e["kind"] for e in events]
+        assert kinds.count("launch") == 3
+        assert kinds.count("wedge_kill") == 1
+        assert kinds.count("backoff") == 2
+        exits = [e for e in events if e["kind"] == "child_exit"]
+        assert exits[0]["returncode"] == EXIT_PREEMPTED
+        assert exits[0]["outcome"] == "preempted"
+        assert exits[-1]["outcome"] == "completed"
+        # wedge detection fired in bounded time: kill decision landed
+        # within (child startup + a few steps + deadline), far below the
+        # injected 600s sleep it interrupted
+        launch_1 = [e for e in events
+                    if e["kind"] == "launch" and e["attempt"] == 1][0]
+        wedge = [e for e in events if e["kind"] == "wedge_kill"][0]
+        assert wedge["attempt"] == 1
+        assert wedge["time"] - launch_1["time"] < 60.0
+
+        # child-side continuity: the wedged attempt is SIGKILLed and
+        # leaves no record; attempts 0 and 2 bracket the run
+        recs = [json.loads(line) for line in
+                open(tmp_path / "progress.jsonl")]
+        assert [r["outcome"] for r in recs] == ["preempted", "completed"]
+        assert recs[0]["attempt"] == 0 and recs[0]["mesh"] == "data=8"
+        assert recs[1]["attempt"] == 2 and "model=2" in recs[1]["mesh"]
+        # no checkpointed step is ever lost: the resume starts exactly
+        # where the preempted attempt flushed
+        assert recs[1]["start_step"] == recs[0]["final_step"]
+        assert recs[0]["final_step"] >= 7
+        assert recs[1]["final_step"] >= 18
+
+        # the wedged attempt's SIGTERM dump captured its cross-topology
+        # resume — obs_report's restarts section joins on exactly this
+        child_rec = json.load(open(tmp_path / "flightrec.json"))
+        resumes = [e for e in child_rec["events"]
+                   if e["kind"] == "resume"]
+        assert resumes and resumes[0]["cross_topology"] is True
+        assert resumes[0]["step"] == recs[0]["final_step"]
+        wedge_faults = [e for e in child_rec["events"]
+                        if e["kind"] == "fault_injected"
+                        and "wedge" in e["fault"]]
+        assert wedge_faults

@@ -51,6 +51,16 @@ def load_flight(run_dir: str) -> Optional[Dict[str, Any]]:
         return json.load(f)
 
 
+def load_supervisor(run_dir: str) -> Optional[Dict[str, Any]]:
+    """The run supervisor's own flight record (launch / backoff /
+    wedge-kill decisions) — written by ``tools/supervise.py``."""
+    path = os.path.join(run_dir, "flightrec_supervisor.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def load_metrics(run_dir: str) -> List[Dict[str, Any]]:
     path = os.path.join(run_dir, "metrics.jsonl")
     if not os.path.exists(path):
@@ -133,6 +143,11 @@ def summarize(run_dir: str) -> Dict[str, Any]:
                           if exc else None),
         }
 
+    restarts = restart_summary(load_supervisor(run_dir),
+                               load_flight(run_dir))
+    if restarts:
+        out["restarts"] = restarts
+
     rows = load_metrics(run_dir)
     if rows:
         steps = [r for r in rows if not r.get("summary")]
@@ -143,6 +158,45 @@ def summarize(run_dir: str) -> Dict[str, Any]:
                 k: v for k, v in last.items()
                 if isinstance(v, (int, float)) and k != "time"}
     return out
+
+
+def restart_summary(sup: Optional[Dict[str, Any]],
+                    child_flight: Optional[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Restarts/resume section: supervisor decisions (launches,
+    preemptions, wedge kills, backoff waits) joined with the child's
+    resume events (which steps it came back at, whether the topology
+    changed). None when the run was never supervised and never resumed."""
+    out: Dict[str, Any] = {}
+    if sup is not None:
+        ev = sup.get("events", [])
+
+        def count(kind: str) -> int:
+            return sum(1 for e in ev if e.get("kind") == kind)
+
+        exits = [e for e in ev if e.get("kind") == "child_exit"]
+        out.update({
+            "launches": count("launch"),
+            "preemptions": sum(1 for e in exits
+                               if e.get("outcome") == "preempted"),
+            "crashes": sum(1 for e in exits
+                           if e.get("outcome") == "crashed"),
+            "wedge_kills": count("wedge_kill"),
+            "backoff_waits": count("backoff"),
+            "backoff_total_s": round(
+                sum(float(e.get("delay_s", 0.0)) for e in ev
+                    if e.get("kind") == "backoff"), 3),
+            "gave_up": count("gave_up") > 0,
+            "final": sup.get("reason"),
+        })
+    if child_flight is not None:
+        resumes = [e for e in child_flight.get("events", [])
+                   if e.get("kind") == "resume"]
+        if resumes:
+            out["resume_steps"] = [int(e.get("step", 0)) for e in resumes]
+            out["cross_topology_resumes"] = sum(
+                1 for e in resumes if e.get("cross_topology"))
+    return out or None
 
 
 def render(summary: Dict[str, Any]) -> str:
@@ -183,6 +237,23 @@ def render(summary: Dict[str, Any]) -> str:
                      f"kinds={fl['event_kinds']}")
         if fl.get("exception"):
             lines.append(f"  exception: {fl['exception']}")
+    r = summary.get("restarts")
+    if r:
+        lines.append("")
+        parts = []
+        if "launches" in r:
+            parts.append(
+                f"launches={r['launches']} "
+                f"preemptions={r['preemptions']} crashes={r['crashes']} "
+                f"wedge_kills={r['wedge_kills']} "
+                f"backoff={r['backoff_total_s']:.1f}s"
+                f"×{r['backoff_waits']} final={r['final']}"
+                + (" GAVE-UP" if r.get("gave_up") else ""))
+        if r.get("resume_steps"):
+            parts.append(
+                f"resumed at steps {r['resume_steps']} "
+                f"({r['cross_topology_resumes']} cross-topology)")
+        lines.append("restarts: " + "; ".join(parts))
     m = summary.get("metrics")
     if m:
         lines.append("")
@@ -217,12 +288,31 @@ def _check() -> int:
 
         rec = FlightRecorder(capacity=16)
         rec.record("step", step=1, loss=0.9)
+        rec.record("resume", step=1, cross_topology=True,
+                   saved_topology="data=8", current_topology="data=4")
         rec.record("step", step=2, loss=float("nan"))
         rec.record("divergence", step=2)
         rec.configure(os.path.join(run_dir, "flightrec.json"),
                       {"model": "mnist_fcn", "batch": 64})
         assert rec.dump("divergence",
                         exception=FloatingPointError("loss=nan"))
+
+        # supervisor decision log, through the same real recorder API
+        sup = FlightRecorder(capacity=16)
+        sup.record("launch", attempt=0, argv=["python", "train.py"])
+        sup.record("child_exit", attempt=0, returncode=75,
+                   outcome="preempted")
+        sup.record("backoff", attempt=1, outcome="preempted", delay_s=1.2)
+        sup.record("launch", attempt=1, argv=["python", "train.py"])
+        sup.record("wedge_kill", attempt=1, pid=123, deadline_s=2.0)
+        sup.record("backoff", attempt=2, outcome="wedged", delay_s=2.4)
+        sup.record("launch", attempt=2, argv=["python", "train.py"])
+        sup.record("child_exit", attempt=2, returncode=0,
+                   outcome="completed")
+        sup.record("completed", attempt=2)
+        assert sup.configure(
+            os.path.join(run_dir, "flightrec_supervisor.json")
+        ).dump("completed", include_hbm=False)
 
         with open(os.path.join(run_dir, "metrics.jsonl"), "w") as f:
             f.write(json.dumps({"step": 1, "time": 0.0,
@@ -241,7 +331,16 @@ def _check() -> int:
         assert summary["flight"]["event_kinds"]["step"] == 2, summary
         assert "FloatingPointError" in summary["flight"]["exception"]
         assert summary["metrics"]["rows"] == 2, summary
-        for token in ("data_wait", "train_step", "divergence"):
+        r = summary["restarts"]
+        assert r["launches"] == 3 and r["preemptions"] == 1, r
+        assert r["wedge_kills"] == 1 and r["crashes"] == 0, r
+        assert r["backoff_waits"] == 2, r
+        assert abs(r["backoff_total_s"] - 3.6) < 1e-6, r
+        assert r["final"] == "completed" and not r["gave_up"], r
+        assert r["resume_steps"] == [1], r
+        assert r["cross_topology_resumes"] == 1, r
+        for token in ("data_wait", "train_step", "divergence",
+                      "restarts:", "cross-topology"):
             assert token in report, report
     print("obs_report --check: ok")
     return 0

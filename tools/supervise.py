@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Supervised training launcher: restart-on-preemption with backoff.
+
+Wraps any training command in the elastic run supervisor (README
+"Elastic run policy"): launches it, watches the heartbeat file the
+Trainer writes (``DLTPU_HEARTBEAT`` is exported automatically),
+distinguishes slow from wedged, kills and requeues on preemption
+(exit 75) / crash / wedge under a bounded restart budget, and records
+every decision to ``<workdir>/flightrec_supervisor.json``.
+
+Usage:
+  python tools/supervise.py [options] -- python tools/train.py \
+      train.workdir=runs/vit train.async_checkpoint=true ...
+
+The training command must checkpoint into a stable workdir — resume is
+the child's own auto-resume; the supervisor only restarts it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--workdir", default="runs/supervised",
+                        help="supervisor state dir (heartbeat + "
+                             "flightrec_supervisor.json)")
+    parser.add_argument("--max-restarts", type=int, default=5,
+                        help="restart budget across preemptions, "
+                             "crashes, and wedge kills")
+    parser.add_argument("--wedge-deadline", type=float, default=120.0,
+                        help="seconds with neither step nor activity "
+                             "progress before the child counts as wedged")
+    parser.add_argument("--startup-deadline", type=float, default=600.0,
+                        help="seconds to wait for the first heartbeat "
+                             "(covers import + first compile)")
+    parser.add_argument("--backoff-base", type=float, default=1.0)
+    parser.add_argument("--backoff-factor", type=float, default=2.0)
+    parser.add_argument("--backoff-max", type=float, default=60.0)
+    parser.add_argument("--kill-grace", type=float, default=10.0,
+                        help="seconds between SIGTERM and SIGKILL when "
+                             "killing a wedged child")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command (prefix with --)")
+    args = parser.parse_args(argv)
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no training command given (put it after --)")
+
+    from deeplearning_tpu.elastic.supervisor import (Supervisor,
+                                                     SupervisorConfig)
+    cfg = SupervisorConfig(
+        command,
+        workdir=args.workdir,
+        max_restarts=args.max_restarts,
+        wedge_deadline_s=args.wedge_deadline,
+        startup_deadline_s=args.startup_deadline,
+        backoff_base_s=args.backoff_base,
+        backoff_factor=args.backoff_factor,
+        backoff_max_s=args.backoff_max,
+        kill_grace_s=args.kill_grace,
+    )
+    return Supervisor(cfg).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -337,7 +337,13 @@ def main(argv=None) -> int:
             trainer.precompile()
         except Exception as e:  # noqa: BLE001 - warmup is best-effort
             print(f"precompile skipped: {e}")
-    trainer.train()
+    from deeplearning_tpu.elastic import EXIT_PREEMPTED, Preempted
+    try:
+        trainer.train()
+    except Preempted:
+        # checkpoint + flight ring already flushed by the Trainer; 75
+        # tells the supervisor "requeue me", not "I crashed"
+        return EXIT_PREEMPTED
     results = trainer.evaluate()
     print({k: round(v, 4) for k, v in results.items()})
     return 0
