@@ -229,6 +229,52 @@ def _obs_smoke():
     return res
 
 
+def _recovery_smoke():
+    """Self-healing idle-cost smoke on the host CPU: the same jitted
+    train step timed bare vs with the Trainer's per-step recovery hooks
+    (anchor cadence check + cooldown compare) at a cadence that never
+    snapshots. The README "Self-healing policy" budget is <2% of step
+    time for a healthy run — this keeps that number next to the MFU it
+    would tax."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from bench_util import recovery_overhead
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.train import TrainState, make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+        from deeplearning_tpu.train.optim import build_optimizer
+        from deeplearning_tpu.train.schedules import build_schedule
+
+        model = MODELS.build("mnist_fcn", num_classes=10)
+        rng = jax.random.key(0)
+        params = model.init(rng, jnp.zeros((1, 28, 28, 1)),
+                            train=False)["params"]
+        tx = build_optimizer(
+            "sgd", build_schedule("constant", base_lr=1e-2), params=params)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        data = {
+            "image": jnp.asarray(np.random.default_rng(0).normal(
+                size=(64, 28, 28, 1)), jnp.float32),
+            "label": jnp.asarray(np.random.default_rng(1).integers(
+                0, 10, 64), jnp.int32),
+        }
+        step = jax.jit(make_train_step(make_loss_fn()))
+
+        def one_step(s, b, r):
+            _, m = step(s, b, r)
+            return m["loss"]
+
+        res = recovery_overhead(one_step, (state, data, rng), state,
+                                n=50, reps=3)
+    res["backend"] = "cpu"
+    return res
+
+
 def _health_probe():
     """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
     must complete within _PROBE_DEADLINE_S, else report and exit instead of
@@ -274,6 +320,11 @@ def _health_probe():
             cpu_fallback["obs"] = _obs_smoke()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["obs"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["recovery"] = _recovery_smoke()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["recovery"] = {"error": repr(e)}
         progress[0] += 1
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
@@ -393,6 +444,12 @@ def main():
         rec["obs"] = _obs_smoke()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["obs"] = {"error": repr(e)}
+    try:
+        # self-healing idle-cost smoke: recovery hooks on vs off must
+        # stay within the README policy budget (<2%)
+        rec["recovery"] = _recovery_smoke()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["recovery"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

@@ -15,10 +15,13 @@ unlike the reference no "rank 0 only" guard is needed around saves.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
 import shutil
+import time
+import zlib
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -26,6 +29,35 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from .logging import create_logger
+
+
+def _file_crc(path: str) -> tuple[int, int]:
+    """Streaming (crc32, size) of one file — 1 MB chunks, so verifying a
+    multi-GB checkpoint never materializes it in host RAM."""
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def checksum_dir(root: str) -> Dict[str, Dict[str, int]]:
+    """{relpath: {crc32, size}} over every file under ``root`` — the
+    integrity record written beside a committed checkpoint step."""
+    out: Dict[str, Dict[str, int]] = {}
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            path = os.path.join(dirpath, name)
+            try:
+                crc, size = _file_crc(path)
+            except OSError:
+                continue
+            out[os.path.relpath(path, root)] = {"crc32": crc, "size": size}
+    return out
 
 
 class CheckpointManager:
@@ -40,7 +72,8 @@ class CheckpointManager:
     best-copy, and on close()."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = False):
+                 async_save: bool = False, save_retries: int = 2,
+                 retry_base_s: float = 0.25, retry_max_s: float = 4.0):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -51,6 +84,12 @@ class CheckpointManager:
         )
         self._async = async_save
         self._pending_best: Optional[int] = None
+        # steps whose async write hasn't committed yet — checksummed at
+        # the next wait_until_finished(), when the files exist on disk
+        self._pending_checksums: set[int] = set()
+        self._save_retries = int(save_retries)
+        self._retry_base_s = float(retry_base_s)
+        self._retry_max_s = float(retry_max_s)
         self._logger = create_logger()
 
     def _finish_pending_best(self) -> None:
@@ -75,14 +114,15 @@ class CheckpointManager:
         if self._pending_best is not None:
             # the previous async write has committed by now; copy its
             # best BEFORE this save can trigger max_to_keep GC of it
-            self._mgr.wait_until_finished()
-            self._finish_pending_best()
-        self._mgr.save(step, args=ocp.args.StandardSave(state),
-                       metrics=metrics)
+            self.wait_until_finished()
+        self._save_with_retry(step, state, metrics)
         if topology is not None:
             self._write_topology(step, topology)
-        if not self._async:
+        if self._async:
+            self._pending_checksums.add(step)
+        else:
             self._mgr.wait_until_finished()
+            self._write_checksums(step)
         if is_best:
             self._pending_best = step
             if not self._async:
@@ -90,7 +130,51 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
+        while self._pending_checksums:
+            self._write_checksums(self._pending_checksums.pop())
         self._finish_pending_best()
+
+    def _save_with_retry(self, step: int, state: Any,
+                         metrics: Optional[Dict]) -> None:
+        """Save with capped-exponential-backoff retries (the supervisor's
+        one backoff curve). Between attempts the partial step dir and any
+        Orbax staging dirs are cleared so the retry writes into a clean
+        slot — a half-written dir would otherwise fail the atomic-rename
+        commit forever."""
+        last_exc: Optional[BaseException] = None
+        for attempt in range(1, self._save_retries + 2):
+            try:
+                self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               metrics=metrics)
+                return
+            except Exception as exc:  # noqa: BLE001 - classified below
+                last_exc = exc
+                from ..obs import flight
+                flight.record("ckpt_retry", step=int(step), attempt=attempt,
+                              error=repr(exc))
+                if attempt > self._save_retries:
+                    break
+                try:
+                    self._mgr.wait_until_finished()
+                except Exception:  # noqa: BLE001 - already failing
+                    pass
+                if jax.process_index() == 0:
+                    for pattern in (str(step), f"{step}.orbax*"):
+                        for path in glob.glob(
+                                os.path.join(self.directory, pattern)):
+                            shutil.rmtree(path, ignore_errors=True)
+                self._mgr.reload()
+                from ..elastic.supervisor import backoff_schedule
+                delay = backoff_schedule(
+                    attempt, base_s=self._retry_base_s, factor=2.0,
+                    max_s=self._retry_max_s, jitter=0.25)
+                self._logger.warning(
+                    f"checkpoint save step {step} failed "
+                    f"(attempt {attempt}/{self._save_retries + 1}): "
+                    f"{exc!r}; retrying in {delay:.2f}s")
+                time.sleep(delay)
+        assert last_exc is not None
+        raise last_exc
 
     def flush(self) -> None:
         """Barrier: block until every in-flight async write has
@@ -140,6 +224,122 @@ class CheckpointManager:
             return None
         return self._read_topology_file().get(str(step))
 
+    # -------------------------------------------------- checksum sidecar
+    # Same shape as the topology sidecar: ONE JSON file for the whole
+    # directory ({step: {relpath: {crc32, size}}}), never a file inside
+    # the step dirs Orbax owns.
+    _CHECKSUM_KEEP = 32
+
+    def _checksum_path(self) -> str:
+        return os.path.join(self.directory, "checksums.json")
+
+    def _write_checksums(self, step: int) -> None:
+        if jax.process_index() != 0:
+            return
+        root = os.path.join(self.directory, str(step))
+        if not os.path.isdir(root):
+            return
+        try:
+            docs = self._read_checksum_file()
+            docs[str(step)] = checksum_dir(root)
+            if len(docs) > self._CHECKSUM_KEEP:
+                for key in sorted(docs, key=int)[:-self._CHECKSUM_KEEP]:
+                    del docs[key]
+            tmp = self._checksum_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(docs, f)
+            os.replace(tmp, self._checksum_path())
+        except (OSError, ValueError) as e:
+            self._logger.warning(f"checksum sidecar write failed: {e}")
+
+    def _read_checksum_file(self) -> Dict[str, Any]:
+        try:
+            with open(self._checksum_path()) as f:
+                docs = json.load(f)
+            return docs if isinstance(docs, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def verify_step(self, step: int) -> bool:
+        """True when every file recorded at save time still exists with
+        matching size+crc32. A step with no sidecar entry (saved before
+        hardening, or by a foreign writer) is trusted — verification
+        can only ever REJECT known-bad data, never block a resume."""
+        recorded = self._read_checksum_file().get(str(step))
+        if recorded is None:
+            return True
+        root = os.path.join(self.directory, str(step))
+        for rel, meta in recorded.items():
+            path = os.path.join(root, rel)
+            try:
+                crc, size = _file_crc(path)
+            except OSError:
+                return False
+            if size != meta.get("size") or crc != meta.get("crc32"):
+                return False
+        return True
+
+    def _quarantine_step(self, step: int, reason: str) -> None:
+        """Move a corrupt step dir aside (``corrupt-<step>`` — non-numeric,
+        so Orbax's step scan ignores it) instead of deleting: the operator
+        may want the carcass for forensics."""
+        from ..obs import flight
+        flight.record("ckpt_corrupt", step=int(step), reason=reason)
+        self._logger.warning(
+            f"checkpoint step {step} failed integrity check ({reason}); "
+            f"moving aside and falling back")
+        if jax.process_index() == 0:
+            src = os.path.join(self.directory, str(step))
+            dst = os.path.join(self.directory, f"corrupt-{step}")
+            try:
+                if os.path.isdir(dst):
+                    shutil.rmtree(dst)
+                if os.path.isdir(src):
+                    os.replace(src, dst)
+            except OSError as e:
+                self._logger.warning(f"could not quarantine step {step}: {e}")
+        self._mgr.reload()
+
+    def _newest_step_at_most(self, ceiling: Optional[int]) -> Optional[int]:
+        steps = [s for s in self._mgr.all_steps()
+                 if ceiling is None or s <= ceiling]
+        return max(steps) if steps else None
+
+    def restore_verified(self, state: Any,
+                         step: Optional[int] = None) -> tuple[Any, int]:
+        """Integrity-checked restore with fallback: verify the newest
+        step (<= ``step`` if given) against its checksum sidecar, restore
+        it, and on mismatch or restore failure quarantine the dir and
+        walk back to the next-newest intact step. Returns ``(None, 0)``
+        when nothing restorable remains."""
+        first: Optional[int] = None
+        ceiling = step
+        while True:
+            candidate = self._newest_step_at_most(ceiling)
+            if candidate is None:
+                return None, 0
+            if first is None:
+                first = candidate
+            if not self.verify_step(candidate):
+                self._quarantine_step(candidate, "checksum mismatch")
+                ceiling = candidate - 1
+                continue
+            try:
+                restored = self._mgr.restore(
+                    candidate, args=ocp.args.StandardRestore(state))
+            except Exception as exc:  # noqa: BLE001 - corrupt beyond crc
+                self._quarantine_step(candidate, f"restore failed: {exc!r}")
+                ceiling = candidate - 1
+                continue
+            if candidate != first:
+                from ..obs import flight
+                flight.record("ckpt_fallback", from_step=int(first),
+                              to_step=int(candidate))
+                self._logger.warning(
+                    f"restored fallback step {candidate} "
+                    f"(newest step {first} was corrupt)")
+            return restored, candidate
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
@@ -156,11 +356,10 @@ class CheckpointManager:
         the swin auto_resume_helper pattern (torch_utils.py:261-271).
         Restores into ``state``'s existing shardings; for resuming onto
         a *different* mesh use ``elastic.resume.elastic_restore``."""
-        step = self.latest_step()
-        if step is None:
+        restored, step = self.restore_verified(state)
+        if restored is None:
             return state, 0
         self._logger.info(f"auto-resume from step {step} in {self.directory}")
-        restored = self.restore(state, step)
         try:
             from ..elastic import topology as topo
             from ..obs import flight

@@ -1,3 +1,4 @@
 from . import datasets, label_convert, mixup, samplers, transforms, zip_cache  # noqa: F401
 from .device_prefetch import DevicePrefetcher  # noqa: F401
 from .loader import ArraySource, MapSource, DataLoader, prefetch_to_device  # noqa: F401
+from .quarantine import PoisonedData, QuarantineLog, quarantinable  # noqa: F401

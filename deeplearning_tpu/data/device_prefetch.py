@@ -113,6 +113,22 @@ class DevicePrefetcher:
         fn = getattr(self.loader, "element_spec", None)
         return fn() if fn is not None else None
 
+    def reseed(self, salt: int) -> None:
+        """Delegate divergence-recovery reseeding (skip-the-window) to
+        the wrapped loader, discarding any already-started pipeline —
+        its batches were drawn from the old permutation."""
+        fn = getattr(self.loader, "reseed", None)
+        if fn is not None:
+            fn(salt)
+        if self._active is not None:
+            self._shutdown(self._active)
+            self._active = None
+
+    @property
+    def quarantine(self):
+        """The wrapped loader's QuarantineLog, if any."""
+        return getattr(self.loader, "quarantine", None)
+
     # ---------------------------------------------------- device place
     def _to_device(self, batch):
         def put(x):
@@ -156,10 +172,17 @@ class DevicePrefetcher:
             if not stop.is_set():
                 q.put(_END)
         except BaseException as exc:  # noqa: BLE001 - relayed to consumer
-            try:
-                q.put(_WorkerError(exc), timeout=1.0)
-            except queue.Full:
-                pass
+            # same responsive bounded-put as the data path: a one-shot
+            # put(timeout=1.0) against a full queue used to DROP the
+            # exception, turning a worker crash into a silent early end
+            # of the epoch — the consumer must re-raise it, with the
+            # original traceback riding on exc.__traceback__
+            while not stop.is_set():
+                try:
+                    q.put(_WorkerError(exc), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def start(self) -> None:
         """Eagerly start producing the CURRENT epoch's batches.
@@ -220,7 +243,7 @@ class DevicePrefetcher:
     def stats(self) -> Dict[str, float]:
         """Feed telemetry snapshot for throughput_stats / bench rows."""
         busy = self.source_wait_total + self.h2d_wait_total
-        return {
+        out = {
             "prefetch_depth": float(self.depth),
             "prefetch_occupancy": self.occupancy_mean,
             "batches_fed": float(self.batches_fed),
@@ -228,6 +251,9 @@ class DevicePrefetcher:
             "h2d_wait_total": self.h2d_wait_total,
             "h2d_wait_frac": (self.h2d_wait_total / busy) if busy else 0.0,
         }
+        if self.quarantine is not None:
+            out["quarantined"] = float(self.quarantine.quarantined)
+        return out
 
     def reset_stats(self) -> None:
         self.last_data_wait = None

@@ -18,7 +18,9 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 import jax
 import numpy as np
 
+from ..elastic import faults
 from ..parallel.sharding import batch_spec, make_global_array
+from .quarantine import PoisonedData, QuarantineLog, quarantinable
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -82,7 +84,7 @@ class DataLoader:
                  seed: int = 0, mesh: Optional[Mesh] = None,
                  transform: Optional[Callable[[Dict], Dict]] = None,
                  infinite: bool = False, num_workers: int = 0,
-                 lookahead: int = 4):
+                 lookahead: int = 4, quarantine=None):
         self.source = source
         self.global_batch = global_batch
         self.shuffle = shuffle
@@ -94,6 +96,20 @@ class DataLoader:
         self.num_workers = num_workers
         self.lookahead = max(lookahead, 1)
         self._pool = None
+        # bad-sample quarantine (README "Self-healing policy"): a
+        # QuarantineLog (or a manifest path to build one) switches fetch
+        # to per-sample so a decode failure substitutes + logs instead
+        # of killing the epoch; None keeps the fast vectorized path.
+        self.quarantine: Optional[QuarantineLog] = (
+            QuarantineLog(quarantine) if isinstance(quarantine, str)
+            else quarantine)
+        self._fetch_counter = itertools.count(1)  # bad_sample fault site
+        self._last_good: Optional[Dict[str, Any]] = None
+        # divergence rollback support: a reseed(salt) perturbs the
+        # shuffle seed so the replayed window draws a different
+        # permutation — the "skip past the offending data" half of the
+        # Trainer's rollback-and-skip.
+        self._seed_salt = 0
         # when False, batches are yielded as HOST numpy dicts even with a
         # mesh — a wrapping DevicePrefetcher flips this to take over the
         # host→HBM transfer on its worker thread (exactly one transfer
@@ -117,9 +133,20 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
+    def reseed(self, salt: int) -> None:
+        """Perturb the effective shuffle seed (idempotent per ``salt``).
+        After a divergence rollback the Trainer replays from its anchor;
+        with the SAME permutation it would march straight back into the
+        offending batch — a new salt draws a fresh permutation, which is
+        the skip."""
+        self._seed_salt = int(salt)
+
+    def _effective_seed(self) -> int:
+        return self.seed + self._seed_salt * 1_000_003
+
     def _local_indices(self, epoch: int) -> Iterator[np.ndarray]:
         idx = epoch_indices(len(self.source), shuffle=self.shuffle,
-                            seed=self.seed, epoch=epoch,
+                            seed=self._effective_seed(), epoch=epoch,
                             drop_last_to=self.global_batch)
         # contiguous host slice of each global batch
         p = jax.process_index()
@@ -165,47 +192,105 @@ class DataLoader:
             return jax.ShapeDtypeStruct(shape, v.dtype)
         return {k: spec(v) for k, v in sample.items()}
 
+    # ------------------------------------------------ per-sample fetch
+    def _fetch_one(self, i: int) -> Dict[str, np.ndarray]:
+        """One sample through the fault harness (``bad_sample@step:N``
+        counts FETCHES); exceptions propagate to the caller — the
+        quarantine decision lives on the consumer thread."""
+        ordinal = next(self._fetch_counter)
+        if faults.consume("bad_sample", "step", step=ordinal):
+            raise faults.InjectedBadSample(
+                f"injected bad sample at fetch {ordinal} (index {i})")
+        return self.source[int(i)]
+
+    def _quarantine_or_raise(self, i: int, exc: BaseException) -> None:
+        """Quarantine a per-sample failure, or re-raise it on the
+        consumer thread with its original traceback when it is not a
+        sample's fault (interrupts, escalation, OOM)."""
+        if self.quarantine is None or not quarantinable(exc):
+            raise exc
+        self.quarantine.record(int(i), exc, step=self.epoch)
+
+    def _assemble(self, local, samples) -> Dict[str, Any]:
+        """Stack per-sample dicts into one fixed-shape batch,
+        substituting quarantined slots (None) with good samples so jit
+        never sees a short batch. A batch with NO survivors is a hard
+        error — there is nothing honest to substitute."""
+        good = [s for s in samples if s is not None]
+        if good:
+            self._last_good = good[-1]
+            if self.quarantine is not None:
+                self.quarantine.note_ok(len(good))
+        elif self._last_good is not None:
+            good = [self._last_good]
+        else:
+            raise PoisonedData(
+                f"every sample in batch {list(map(int, local))} failed "
+                "with none seen before it — nothing to substitute")
+        samples = [s if s is not None else good[j % len(good)]
+                   for j, s in enumerate(samples)]
+        return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
     def _epoch_iter(self, epoch: int) -> Iterator[Dict[str, Any]]:
         if self.num_workers:
             yield from self._epoch_iter_parallel(epoch)
             return
         for local in self._local_indices(epoch):
-            yield self._finalize(self.source[local])
+            if self.quarantine is None:
+                yield self._finalize(self.source[local])
+                continue
+            samples = []
+            for i in local:
+                try:
+                    samples.append(self._fetch_one(int(i)))
+                except BaseException as exc:  # noqa: BLE001
+                    self._quarantine_or_raise(int(i), exc)
+                    samples.append(None)
+            yield self._finalize(self._assemble(local, samples))
 
     def _epoch_iter_parallel(self, epoch: int) -> Iterator[Dict[str, Any]]:
         """num_workers>0: decode samples on a thread pool (the DataLoader
         num_workers analog — PIL/cv2 JPEG decode releases the GIL), keeping
         ``lookahead`` batches of per-sample futures in flight so decode
-        overlaps step compute."""
+        overlaps step compute. Worker exceptions surface HERE, on the
+        consumer thread with their original tracebacks (``f.result()``
+        re-raises) — quarantinable ones substitute + log, everything
+        else kills the epoch loudly, never silently."""
         if self._pool is None:
             import concurrent.futures
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.num_workers)
-        fetch = lambda i: self.source[int(i)]
         pending: collections.deque = collections.deque()
         it = self._local_indices(epoch)
         self.data_wait_total = 0.0
         import time as _time
+
+        def submit(local):
+            pending.append((local, [self._pool.submit(self._fetch_one, i)
+                                    for i in local]))
         try:
             for local in itertools.islice(it, self.lookahead):
-                pending.append([self._pool.submit(fetch, i) for i in local])
+                submit(local)
             while pending:
-                futs = pending.popleft()
+                local, futs = pending.popleft()
                 # queue-empty wait: blocking on not-yet-done futures IS
                 # the starvation signal (done futures return instantly),
                 # so this isolates decode lag from batch assembly below
                 t0 = _time.perf_counter()
-                samples = [f.result() for f in futs]
+                samples = []
+                for i, f in zip(local, futs):
+                    try:
+                        samples.append(f.result())
+                    except BaseException as exc:  # noqa: BLE001
+                        self._quarantine_or_raise(int(i), exc)
+                        samples.append(None)
                 self.last_data_wait = _time.perf_counter() - t0
                 self.data_wait_total += self.last_data_wait
-                batch = {k: np.stack([s[k] for s in samples])
-                         for k in samples[0]}
-                yield self._finalize(batch)
+                yield self._finalize(self._assemble(local, samples))
                 for local in itertools.islice(it, 1):
-                    pending.append([self._pool.submit(fetch, i)
-                                    for i in local])
+                    submit(local)
         finally:
-            for futs in pending:
+            for _, futs in pending:
                 for f in futs:
                     f.cancel()
 

@@ -11,6 +11,7 @@ Grammar (``;``-separated specs, each ``@``-separated fields)::
     DLTPU_FAULTS="sigterm@step:5@attempt:0;crash@checkpoint;wedge@step:3"
 
     kind      := sigterm | sigint | crash | wedge
+               | nan | bad_sample | ckpt_corrupt
     site      := step[:N] | checkpoint[:N]   (N = fire at host step >= N;
                                               omitted = first visit)
     attempt:K := only fire on restart attempt K (DLTPU_RESTART_ATTEMPT,
@@ -25,6 +26,23 @@ Each spec fires at most once per process. Actions:
 - ``wedge``: block in ``time.sleep`` while the heartbeat writer thread
   keeps the file fresh — exactly the wedged-device-tunnel signature
   (process alive, loop stuck) the supervisor must classify and kill.
+
+The self-healing kinds (``nan``, ``bad_sample``, ``ckpt_corrupt``) are
+*consumed*, not fired: :func:`maybe_fire` never delivers them — the
+subsystem that owns the effect polls :func:`consume` and applies it
+through its REAL code path, so the recovery machinery is exercised end
+to end instead of shortcut into:
+
+- ``nan@step:N``: the Trainer poisons its params with NaN at host step
+  N, so the next dispatched step's jitted ``bad_step`` flag fires and
+  divergence recovery (rollback or abort) runs for real.
+- ``bad_sample@step:N``: the DataLoader's per-sample fetch raises
+  :class:`InjectedBadSample` at fetch ordinal N — the quarantine path's
+  test handle (``step`` here counts SAMPLE fetches, not train steps).
+- ``ckpt_corrupt@step:N``: after the checkpoint write at step >= N
+  commits, the Trainer garbles the step dir on disk
+  (:func:`corrupt_checkpoint`), so restore-time verification must fall
+  back to the previous intact step.
 """
 
 from __future__ import annotations
@@ -35,12 +53,17 @@ import time
 from typing import List, Optional
 
 __all__ = ["ENV_VAR", "ATTEMPT_VAR", "FaultSpec", "InjectedCrash",
-           "parse_faults", "active_faults", "maybe_fire", "reset"]
+           "InjectedBadSample", "parse_faults", "active_faults",
+           "maybe_fire", "consume", "corrupt_checkpoint", "reset"]
 
 ENV_VAR = "DLTPU_FAULTS"
 ATTEMPT_VAR = "DLTPU_RESTART_ATTEMPT"
 
-_KINDS = ("sigterm", "sigint", "crash", "wedge")
+_KINDS = ("sigterm", "sigint", "crash", "wedge",
+          "nan", "bad_sample", "ckpt_corrupt")
+# kinds applied by their owning subsystem via consume(); maybe_fire
+# skips them so the generic step/checkpoint hooks can't double-deliver
+_CONSUMED_KINDS = ("nan", "bad_sample", "ckpt_corrupt")
 _SITES = ("step", "checkpoint")
 
 # long enough that only the supervisor's wedge kill ends it, short
@@ -50,6 +73,12 @@ WEDGE_SLEEP_S = 600.0
 
 class InjectedCrash(RuntimeError):
     """The ``crash`` fault: an ordinary hard failure, exit code != 75."""
+
+
+class InjectedBadSample(ValueError):
+    """The ``bad_sample`` fault: a per-sample decode failure, raised
+    inside the loader's fetch so the quarantine path catches it exactly
+    where a real corrupt JPEG would surface."""
 
 
 class FaultSpec:
@@ -146,11 +175,61 @@ def maybe_fire(site: str, step: int = 0) -> None:
         return
     attempt = current_attempt()
     for spec in specs:
+        if spec.kind in _CONSUMED_KINDS:
+            continue
         if not spec.matches(site, step, attempt):
             continue
         spec.fired = True
         _fire(spec, step)
         return
+
+
+def consume(kind: str, site: str, step: int = 0) -> bool:
+    """Poll-style faults: True once when a matching un-fired spec of
+    ``kind`` exists — the CALLER owns the effect (poison params, raise a
+    decode error, garble a step dir), so the fault flows through the
+    same code path a real failure would."""
+    specs = active_faults()
+    if not specs:
+        return False
+    attempt = current_attempt()
+    for spec in specs:
+        if spec.kind != kind or not spec.matches(site, step, attempt):
+            continue
+        spec.fired = True
+        from ..obs import flight
+        flight.record("fault_injected", fault=repr(spec), step=int(step))
+        return True
+    return False
+
+
+def corrupt_checkpoint(directory: str, step: int,
+                       n_files: int = 1) -> List[str]:
+    """Garble the largest file(s) of a COMMITTED checkpoint step dir
+    (bit-flip a chunk in the middle) — the ``ckpt_corrupt`` fault's
+    effect, applied after the write lands so Orbax's atomic-rename
+    commit sees nothing. Returns the paths touched."""
+    root = os.path.join(directory, str(step))
+    candidates = []
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            path = os.path.join(dirpath, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size > 0:
+                candidates.append((size, path))
+    candidates.sort(reverse=True)
+    hit = []
+    for size, path in candidates[:max(int(n_files), 1)]:
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(min(64, size - size // 2)) or b"\x00"
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        hit.append(path)
+    return hit
 
 
 def _fire(spec: FaultSpec, step: int) -> None:

@@ -28,7 +28,8 @@ from typing import Callable, Iterable, List, Optional
 
 from . import signals
 
-__all__ = ["EXIT_PREEMPTED", "Preempted", "PreemptionGuard"]
+__all__ = ["EXIT_PREEMPTED", "Preempted", "PreemptionGuard",
+           "agree_preempt_step"]
 
 # sysexits EX_TEMPFAIL: "transient failure, retry" — the supervisor's
 # contract for "requeue me, this was a preemption, not a bug".
@@ -101,3 +102,24 @@ class PreemptionGuard:
 
     def requested(self) -> bool:
         return self._event.is_set()
+
+
+def agree_preempt_step(step: int) -> int:
+    """Multi-host preemption agreement: process 0 broadcasts ITS step so
+    every host lands the same checkpoint step (a pod-wide SIGTERM
+    reaches hosts at slightly different step boundaries — without
+    agreement each host would save a different step and the restore
+    would mix them). One tiny all-reduce; a no-op on single-host, and a
+    best-effort identity if the collective itself fails (a dying pod
+    should still land SOME checkpoint)."""
+    import jax                       # lazy: keep this module jax-free
+    if jax.process_count() == 1:
+        return int(step)
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        agreed = multihost_utils.broadcast_one_to_all(
+            np.asarray(int(step), np.int64))
+        return int(agreed)
+    except Exception:  # noqa: BLE001 - never block the landing on it
+        return int(step)

@@ -42,13 +42,15 @@ def elastic_restore(ckpt: CheckpointManager, state: Any, mesh: Mesh,
     i.e. calling this unconditionally at startup is the whole resume
     policy."""
     target = shard_state(state, mesh, rules)
-    step = ckpt.latest_step() if step is None else step
-    if step is None:
+    # integrity-checked restore: a corrupt newest step is quarantined and
+    # the next intact one restored instead (core.checkpoint hardening)
+    restored, got = ckpt.restore_verified(target, step)
+    if restored is None:
         return target, 0
+    step = got
     saved_topo = ckpt.topology(step)
     current = topo.current_topology(mesh)
     cross = topo.topology_changed(saved_topo, current)
-    restored = ckpt.restore(target, step)
     flight.record(
         "resume", step=int(step), cross_topology=bool(cross),
         saved_topology=topo.topology_str(saved_topo),

@@ -44,7 +44,7 @@ from . import faults, heartbeat
 from .preempt import EXIT_PREEMPTED
 
 __all__ = ["SupervisorConfig", "Supervisor", "WedgeDetector",
-           "backoff_delay"]
+           "backoff_delay", "backoff_schedule"]
 
 
 class SupervisorConfig:
@@ -82,15 +82,26 @@ class SupervisorConfig:
         self.seed = seed
 
 
+def backoff_schedule(attempt: int, *, base_s: float, factor: float,
+                     max_s: float, jitter: float,
+                     rng: Optional[random.Random] = None) -> float:
+    """Capped-exponential-plus-jitter delay before retry ``attempt``
+    (1-based) — the one backoff curve in the codebase. The supervisor's
+    requeue waits and the checkpoint manager's save retries both go
+    through here, so a preemption storm (or an NFS brownout) never
+    restarts/rewrites a whole fleet in lockstep."""
+    base = min(base_s * (factor ** max(attempt - 1, 0)), max_s)
+    u = (rng or random).random()
+    return base * (1.0 + jitter * u)
+
+
 def backoff_delay(attempt: int, cfg: SupervisorConfig,
                   rng: Optional[random.Random] = None) -> float:
-    """Delay before restart number ``attempt`` (1-based): capped
-    exponential plus proportional jitter so a preemption storm doesn't
-    restart a whole fleet in lockstep."""
-    base = cfg.backoff_base_s * (cfg.backoff_factor ** max(attempt - 1, 0))
-    base = min(base, cfg.backoff_max_s)
-    u = (rng or random).random()
-    return base * (1.0 + cfg.backoff_jitter * u)
+    """Delay before restart number ``attempt`` under ``cfg``'s knobs."""
+    return backoff_schedule(attempt, base_s=cfg.backoff_base_s,
+                            factor=cfg.backoff_factor,
+                            max_s=cfg.backoff_max_s,
+                            jitter=cfg.backoff_jitter, rng=rng)
 
 
 class WedgeDetector:

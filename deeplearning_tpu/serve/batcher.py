@@ -124,6 +124,7 @@ class MicroBatcher:
                  default_timeout_s: Optional[float] = None,
                  admission: Optional[AdmissionController] = None,
                  telemetry: Optional[ServeTelemetry] = None,
+                 heartbeat=None,
                  start: bool = True):
         self.engine = engine
         self.max_wait_s = max_wait_ms / 1e3
@@ -131,6 +132,12 @@ class MicroBatcher:
             engine.buckets, max_queue=max_queue,
             default_timeout_s=default_timeout_s)
         self.telemetry = telemetry or ServeTelemetry()
+        # elastic surface: an elastic.heartbeat.Heartbeat whose activity
+        # watermark advances once per dispatched batch — the same
+        # liveness contract the Trainer gives its supervisor
+        self._beat = heartbeat
+        self.dispatched = 0            # batches the dispatch loop finished
+        self._busy = False             # dispatch thread is inside a batch
         self._q: "queue.Queue[_Request]" = queue.Queue()
         self._ids = itertools.count()
         self._stop = threading.Event()
@@ -161,6 +168,13 @@ class MicroBatcher:
     @property
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    @property
+    def busy(self) -> bool:
+        """True while the dispatch thread is inside a batch (collected
+        but not yet demuxed) — a wedge detector must not call an
+        in-flight batch idle."""
+        return self._busy
 
     # ----------------------------------------------------------- submit
     def submit(self, image, timeout_s: Optional[float] = None
@@ -231,29 +245,41 @@ class MicroBatcher:
             batch = self._collect()
             if not batch:
                 continue
-            t0 = time.perf_counter()
-            depth = self._q.qsize()
-            shed = self.admission.overloaded(depth)
-            bucket = (self.engine.buckets[-1] if shed
-                      else self.engine.bucket_for(len(batch)))
+            self._busy = True
             try:
-                with span("serve/dispatch", bucket=bucket, n=len(batch),
-                          depth=depth, shed=shed):
-                    padded = self.engine.pad_to_bucket(
-                        np.stack([r.image for r in batch]), bucket)
-                    out = self.engine.run(bucket, padded)
-            except BaseException as exc:  # noqa: BLE001 - to the futures
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(exc)
-                continue
-            now = time.perf_counter()
-            shared = _SharedBatch(out)
-            for i, r in enumerate(batch):
-                # hand each request its row of the shared device batch —
-                # no sync here; the first result() call materializes once
-                r.future.set_result((shared, i))
-                self.telemetry.record_dispatch_latency(now - r.t_submit)
-            self.telemetry.record_batch(bucket, len(batch),
-                                        self._q.qsize(), shed)
-            self.admission.note_drained(len(batch), now - t0)
+                self._dispatch_one(batch)
+            finally:
+                # count the batch whether it ran or errored — both mean
+                # the dispatch thread is ALIVE (what a wedge probe asks)
+                self._busy = False
+                self.dispatched += 1
+                if self._beat is not None:
+                    self._beat.touch("dispatch", step=self.dispatched)
+
+    def _dispatch_one(self, batch: list) -> None:
+        t0 = time.perf_counter()
+        depth = self._q.qsize()
+        shed = self.admission.overloaded(depth)
+        bucket = (self.engine.buckets[-1] if shed
+                  else self.engine.bucket_for(len(batch)))
+        try:
+            with span("serve/dispatch", bucket=bucket, n=len(batch),
+                      depth=depth, shed=shed):
+                padded = self.engine.pad_to_bucket(
+                    np.stack([r.image for r in batch]), bucket)
+                out = self.engine.run(bucket, padded)
+        except BaseException as exc:  # noqa: BLE001 - to the futures
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            return
+        now = time.perf_counter()
+        shared = _SharedBatch(out)
+        for i, r in enumerate(batch):
+            # hand each request its row of the shared device batch —
+            # no sync here; the first result() call materializes once
+            r.future.set_result((shared, i))
+            self.telemetry.record_dispatch_latency(now - r.t_submit)
+        self.telemetry.record_batch(bucket, len(batch),
+                                    self._q.qsize(), shed)
+        self.admission.note_drained(len(batch), now - t0)

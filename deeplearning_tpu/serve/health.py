@@ -18,27 +18,67 @@ no device work, no syncs, safe to poll at any rate:
 The payload carries the operating numbers next to the verdict (queue
 depth, e2e p99, reject count, bucket table) so a 503 is diagnosable
 from the probe alone.
+
+**Wedged?** (PR 7) ``DispatchWatch`` applies the supervisor's
+``WedgeDetector`` grammar to the serving path: requests queued (or a
+batch in flight) while the dispatched-batch counter is frozen past the
+deadline means the device stream is stuck — the worst serving failure
+mode, because the process still accepts connections. An idle server
+(empty queue, dispatch thread parked) ticks the detector's activity
+itself, so quiet traffic never reads as wedged.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
-__all__ = ["health"]
+from ..elastic.supervisor import WedgeDetector
+
+__all__ = ["health", "DispatchWatch"]
 
 
-def health(engine, batcher=None) -> Tuple[int, Dict[str, Any]]:
+class DispatchWatch:
+    """Wedge verdict over a ``MicroBatcher``'s dispatch progress.
+
+    Each ``verdict()`` call feeds the detector the dispatched-batch
+    counter, plus a synthetic idle tick whenever there is genuinely
+    nothing to do — so only "work waiting, counter frozen for
+    ``deadline_s``" ever reads ``"wedged"``. Host-only; safe to poll
+    from the healthz handler at any rate."""
+
+    def __init__(self, batcher, deadline_s: float = 30.0):
+        self.batcher = batcher
+        self.detector = WedgeDetector(deadline_s)
+        self._idle = 0
+
+    def verdict(self, now: Optional[float] = None) -> str:
+        if self.batcher.queue_depth == 0 and not self.batcher.busy:
+            self._idle += 1           # idle is progress, not a wedge
+        activity = int(self.batcher.dispatched) + self._idle
+        return self.detector.observe(None, activity, now=now)
+
+    def stalled_for(self, now: Optional[float] = None) -> float:
+        return self.detector.stalled_for(now)
+
+
+def health(engine, batcher=None,
+           wedge: Optional[DispatchWatch] = None
+           ) -> Tuple[int, Dict[str, Any]]:
     """(http_status, payload) for one engine (+ optional batcher).
 
     200 "ready": warm engine, not shedding. 503 "warming" until every
-    bucket is compiled; 503 "degraded" while admission sheds. Pure host
-    reads — never compiles, never syncs the device."""
+    bucket is compiled; 503 "degraded" while admission sheds; 503
+    "wedged" (takes precedence) when ``wedge`` reports a frozen
+    dispatch stream. Pure host reads — never compiles, never syncs the
+    device."""
     warm = engine.compile_count >= len(engine.buckets)
     depth = batcher.queue_depth if batcher is not None else 0
     shed = (batcher.admission.overloaded(depth)
             if batcher is not None else False)
-    status = "ready" if warm and not shed else (
-        "warming" if not warm else "degraded")
+    wedged = wedge is not None and wedge.verdict() == "wedged"
+    status = "wedged" if wedged else (
+        "ready" if warm and not shed else (
+            "warming" if not warm else "degraded"))
     payload: Dict[str, Any] = {
         "status": status,
         "engine_warm": warm,
@@ -47,8 +87,12 @@ def health(engine, batcher=None) -> Tuple[int, Dict[str, Any]]:
         "model": engine.name,
         "task": engine.task,
         "buckets": list(engine.buckets),
+        "wedged": wedged,
     }
     if batcher is not None:
         payload["e2e_ms_p99"] = batcher.telemetry.latency_ms("e2e")["p99"]
         payload["rejected"] = batcher.telemetry.rejected
+        payload["dispatched"] = getattr(batcher, "dispatched", 0)
+    if wedged:
+        payload["stalled_s"] = round(wedge.stalled_for(), 3)
     return (200 if status == "ready" else 503), payload

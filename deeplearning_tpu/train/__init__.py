@@ -1,4 +1,6 @@
 from . import lr_finder, multiscale, optim, schedules, trainer  # noqa: F401
 from .async_metrics import DeferredMetrics  # noqa: F401
+from .recovery import (RecoveryExhausted, RecoveryManager,  # noqa: F401
+                       RecoveryPolicy)
 from .state import TrainState  # noqa: F401
 from .steps import make_train_step, make_eval_step, shard_state  # noqa: F401

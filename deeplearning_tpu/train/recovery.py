@@ -1,0 +1,208 @@
+"""Divergence rollback-and-skip: a loss spike is a detour, not a death.
+
+Large-batch training on real data diverges occasionally — a pathological
+batch, an optimizer overflow, a cosmic-ray bitflip in HBM. The seed
+policy (``Trainer(abort_non_finite=True)``) turns the jitted
+``bad_step`` flag into :class:`FloatingPointError`, which at production
+scale wastes everything since the last on-disk checkpoint and burns a
+supervisor restart. This module implements the cheaper industrial
+policy:
+
+1. keep a device-side **anchor** copy of the TrainState, refreshed every
+   ``anchor_every`` steps (one jitted ``jnp.copy`` tree-map — no host
+   transfer, no disk);
+2. when divergence fires, **roll back** to the anchor, **skip** the data
+   window that produced it (the loader is re-seeded, so the replayed
+   span draws a different permutation), and **dampen** updates for a
+   cooldown window;
+3. give up — the seed abort path, with full flight telemetry — only
+   after ``max_recoveries`` rollbacks inside ``budget_steps``.
+
+Anchor correctness under async metrics: the Trainer learns about
+divergence ``metrics_lag`` steps late, so an anchor snapshotted at step
+t is only *promoted* once a verified-finite metrics entry for a step
+``> t`` arrives — entry t+1's loss was computed FROM state t, so a
+finite entry at t+1 proves the params at t were clean. Until promotion a
+snapshot waits in a small pending queue; a rollback clears it.
+
+Donation safety: ``snapshot_state`` is dispatched BEFORE the donating
+``train_step`` call consumes the buffers, and the copy is jitted so
+output shardings mirror the inputs on any mesh.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Deque, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RecoveryPolicy", "RecoveryManager", "RecoveryExhausted",
+           "snapshot_state", "damp_update", "poison_state"]
+
+
+class RecoveryExhausted(RuntimeError):
+    """Rollback budget spent (or no anchor exists): the run is genuinely
+    sick — fall through to the abort path."""
+
+
+class RecoveryPolicy:
+    """Knobs for divergence recovery. ``budget_steps=0`` means the
+    ``max_recoveries`` budget spans the whole run; otherwise only
+    rollbacks within the trailing ``budget_steps`` window count — a
+    2M-step run is allowed one bad day per epoch, not three ever."""
+
+    def __init__(self, *, mode: str = "rollback", anchor_every: int = 50,
+                 max_recoveries: int = 3, budget_steps: int = 0,
+                 cooldown_steps: int = 20, lr_decay: float = 0.1):
+        if mode not in ("rollback", "abort"):
+            raise ValueError(f"mode must be rollback|abort, got {mode!r}")
+        self.mode = mode
+        self.anchor_every = max(int(anchor_every), 1)
+        self.max_recoveries = int(max_recoveries)
+        self.budget_steps = int(budget_steps)
+        self.cooldown_steps = max(int(cooldown_steps), 0)
+        self.lr_decay = float(lr_decay)
+
+
+# jit the copy so it runs device-side and the outputs inherit the input
+# shardings on any mesh; TrainState's static fields (apply_fn, tx) are
+# hashable aux data, so this traces once per trainer.
+@jax.jit
+def _copy_tree(tree: Any) -> Any:
+    return jax.tree.map(jnp.copy, tree)
+
+
+def snapshot_state(state: Any) -> Any:
+    """Device-side deep copy of a TrainState (params + opt state +
+    step + batch_stats). Dispatch this BEFORE a donating train_step call
+    — the copy reads the buffers the step will consume."""
+    return _copy_tree(state)
+
+
+@jax.jit
+def _damp(old: Any, new: Any, scale: jnp.ndarray) -> Any:
+    return jax.tree.map(
+        lambda o, n: o + scale.astype(o.dtype) * (n - o), old, new)
+
+
+def damp_update(old_params: Any, new_params: Any, scale: float) -> Any:
+    """``old + scale * (new - old)`` leaf-wise: shrink one step's param
+    delta by ``scale``. Exactly an LR decay for SGD; the standard
+    post-rollback damping for adaptive optimizers (whose moments keep
+    their own schedule). ``scale`` is traced, so every cooldown strength
+    shares one compiled program."""
+    return _damp(old_params, new_params, jnp.float32(scale))
+
+
+@jax.jit
+def _poison_params(params: Any) -> Any:
+    return jax.tree.map(
+        lambda p: p * jnp.nan if jnp.issubdtype(p.dtype, jnp.floating)
+        else p, params)
+
+
+def poison_state(state: Any) -> Any:
+    """NaN-poison the float params (the ``nan`` fault's effect): the next
+    dispatched step computes a NaN loss through the REAL jitted
+    ``bad_step`` path, so injection exercises detection end to end."""
+    return state.replace(params=_poison_params(state.params))
+
+
+class RecoveryManager:
+    """Owns the anchor lifecycle and the rollback budget. Not
+    thread-safe — everything runs on the Trainer's consumer thread."""
+
+    def __init__(self, policy: Optional[RecoveryPolicy] = None):
+        self.policy = policy or RecoveryPolicy()
+        self._anchor: Optional[Tuple[int, Any]] = None
+        # snapshots awaiting a verified-finite entry newer than them
+        self._pending: Deque[Tuple[int, Any]] = collections.deque(maxlen=8)
+        self._last_snap_step: Optional[int] = None
+        self._cooldown_until = -1
+        self.rollbacks = 0
+        self.recovery_steps: List[int] = []        # budget accounting
+        self.skipped: List[Tuple[int, int]] = []   # (anchor, bad) windows
+
+    # ------------------------------------------------------------ anchor
+    def seed(self, step: int, state: Any) -> None:
+        """Anchor the known-clean starting state (fresh init or a
+        just-restored checkpoint)."""
+        self._anchor = (int(step), snapshot_state(state))
+        self._pending.clear()
+        self._last_snap_step = int(step)
+
+    def maybe_snapshot(self, step: int, state: Any) -> None:
+        """Hot-loop hook: one int compare when idle; every
+        ``anchor_every`` steps, dispatch a device-side copy into the
+        pending queue. Call BEFORE the donating step dispatch."""
+        step = int(step)
+        if step - (self._last_snap_step or 0) < self.policy.anchor_every:
+            return
+        self._last_snap_step = step
+        self._pending.append((step, snapshot_state(state)))
+
+    def mark_verified(self, step: int) -> None:
+        """A metrics entry at ``step`` arrived finite: promote every
+        pending snapshot strictly older than it (entry t+1's loss was
+        computed from state t, so finiteness at t+1 vouches for t)."""
+        step = int(step)
+        promoted = None
+        while self._pending and self._pending[0][0] < step:
+            promoted = self._pending.popleft()
+        if promoted is not None:
+            self._anchor = promoted
+
+    @property
+    def anchor_step(self) -> Optional[int]:
+        return self._anchor[0] if self._anchor is not None else None
+
+    # --------------------------------------------------------- rollback
+    def on_divergence(self, step: int) -> Tuple[int, Any]:
+        """Account one divergence at host step ``step``; return
+        ``(anchor_step, state_copy)`` to roll back to, or raise
+        :class:`RecoveryExhausted` when the budget is spent. The caller
+        gets a COPY of the anchor so a second divergence in the same
+        window can roll back again."""
+        step = int(step)
+        if self.policy.budget_steps > 0:
+            floor = step - self.policy.budget_steps
+            self.recovery_steps = [s for s in self.recovery_steps
+                                   if s >= floor]
+        if self._anchor is None:
+            raise RecoveryExhausted(
+                f"divergence at step {step} with no verified anchor")
+        if len(self.recovery_steps) >= self.policy.max_recoveries:
+            raise RecoveryExhausted(
+                f"divergence at step {step}: {len(self.recovery_steps)} "
+                f"rollbacks already spent (max {self.policy.max_recoveries}"
+                + (f" per {self.policy.budget_steps} steps"
+                   if self.policy.budget_steps else "") + ")")
+        self.recovery_steps.append(step)
+        self.rollbacks += 1
+        anchor_step, anchor_state = self._anchor
+        self.skipped.append((anchor_step, step))
+        # in-flight snapshots may postdate the poison — drop them, and
+        # restart the snapshot cadence from the anchor
+        self._pending.clear()
+        self._last_snap_step = anchor_step
+        self._cooldown_until = anchor_step + self.policy.cooldown_steps
+        return anchor_step, snapshot_state(anchor_state)
+
+    def cooldown_scale(self, step: int) -> Optional[float]:
+        """``lr_decay`` while inside the post-rollback cooldown window,
+        else None (one int compare on the hot path)."""
+        if int(step) < self._cooldown_until:
+            return self.policy.lr_decay
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "rollbacks": self.rollbacks,
+            "rollback_steps": list(self.recovery_steps),
+            "skipped_windows": [list(w) for w in self.skipped],
+            "anchor_step": self.anchor_step,
+            "anchor_every": self.policy.anchor_every,
+            "max_recoveries": self.policy.max_recoveries,
+        }

@@ -31,14 +31,28 @@ from ..core.logging import (LoggerHub, MetricLogger,
 from ..data.device_prefetch import DevicePrefetcher
 from ..elastic import faults
 from ..elastic import heartbeat as hb
-from ..elastic.preempt import Preempted, PreemptionGuard
+from ..elastic.preempt import (Preempted, PreemptionGuard,
+                               agree_preempt_step)
 from ..obs import flight
 from ..obs.spans import span, step_span
 from ..utils.profiling import RetraceGuard
+from . import recovery as recovery_mod
 from .async_metrics import DeferredMetrics
+from .recovery import RecoveryExhausted, RecoveryManager, RecoveryPolicy
 
 HOOKS = ("before_train", "after_train", "before_epoch", "after_epoch",
          "before_iter", "after_iter", "on_evaluate", "on_checkpoint")
+
+
+class _DivergenceDetected(Exception):
+    """Internal control flow: a lagged metrics entry surfaced a
+    non-finite step. Carries the offending entry so the rollback path
+    can report it; never escapes the Trainer."""
+
+    def __init__(self, meta: Dict[str, Any], host: Dict[str, Any]):
+        super().__init__(f"divergence at step {meta.get('step')}")
+        self.meta = meta
+        self.host = host
 
 
 class Callbacks:
@@ -87,8 +101,28 @@ class Trainer:
         hbm_alert_frac: Optional[float] = None,
         preemptible: bool = True,
         heartbeat="auto",
+        recovery=None,
     ):
         self.state = state
+        # self-healing policy (README "Self-healing policy"): None/"abort"
+        # keeps the seed behavior (abort_non_finite raises on the first
+        # bad step); "rollback" (or a RecoveryPolicy / RecoveryManager)
+        # rolls back to a device-side anchor, skips the bad data window,
+        # and dampens updates through a cooldown — aborting only once
+        # the rollback budget is spent.
+        if recovery is None or recovery == "abort":
+            self._recovery: Optional[RecoveryManager] = None
+        elif recovery == "rollback":
+            self._recovery = RecoveryManager(RecoveryPolicy())
+        elif isinstance(recovery, RecoveryPolicy):
+            self._recovery = (RecoveryManager(recovery)
+                              if recovery.mode == "rollback" else None)
+        elif isinstance(recovery, RecoveryManager):
+            self._recovery = recovery
+        else:
+            raise ValueError(f"recovery must be None|'abort'|'rollback'|"
+                             f"RecoveryPolicy|RecoveryManager, "
+                             f"got {recovery!r}")
         # elastic-run wiring (README "Elastic run policy"): preemptible
         # installs the chained SIGTERM/SIGINT guard (flush checkpoint →
         # Preempted at the next step boundary → exit 75); heartbeat
@@ -163,6 +197,7 @@ class Trainer:
                                         window=self.metrics_window or None)
         self.eval_fetches = 0        # host materializations per evaluate()
         self._host_step: Optional[int] = None  # host mirror of state.step
+        self._batches = None         # live epoch iterator (rollback hook)
         self.ckpt = (CheckpointManager(f"{workdir}/ckpt",
                                        async_save=async_checkpoint)
                      if workdir else None)
@@ -322,7 +357,10 @@ class Trainer:
         a periodic save already wrote it), barrier the write, dump the
         flight ring with the distinct 'preempted' reason."""
         if self.ckpt:
-            step = int(self.state.step)     # sync is fine — we're dying
+            # sync is fine — we're dying; on a pod, agree on process 0's
+            # step so every host lands the SAME checkpoint step even
+            # when the pod-wide SIGTERM hit different step boundaries
+            step = agree_preempt_step(int(self.state.step))
             if self.ckpt.latest_step() != step:
                 self._save()
             self.ckpt.flush()
@@ -360,6 +398,9 @@ class Trainer:
                 steps_per_epoch = max(len(self.train_loader), 1)
                 self.epoch = int(step) // steps_per_epoch
                 self._host_step = int(step)
+        if self._recovery is not None:
+            # fresh init or just-restored checkpoint: both known-clean
+            self._recovery.seed(self.host_step, self.state)
         self.callbacks.fire("before_train", self)
         try:
             for epoch in range(self.epoch, self.epochs):
@@ -379,6 +420,12 @@ class Trainer:
             if self.ckpt:
                 self.ckpt.wait_until_finished()
         self.callbacks.fire("after_train", self)
+        if self._recovery is not None and self._recovery.rollbacks \
+                and self.obs_enabled:
+            # the run SURVIVED its divergences — land the evidence in
+            # flightrec.json even though nothing crashed
+            flight.record("recovery_summary", **self._recovery.stats())
+            flight.dump("recovered")
         # self.epochs, not self.epoch: the loop leaves self.epoch at the
         # last INDEX (epochs-1), and summary only runs on normal exit
         summary = {"epochs": self.epochs, **getattr(self, "_last_eval", {})}
@@ -391,6 +438,17 @@ class Trainer:
         return self.state
 
     def _train_one_epoch(self, epoch: int) -> None:
+        """One epoch, retried through divergence rollbacks: each
+        ``_DivergenceDetected`` rolls the state back to the anchor and
+        replays the epoch under a fresh loader permutation (the skip) —
+        the budget inside ``_rollback`` bounds the retries."""
+        while True:
+            try:
+                return self._epoch_pass(epoch)
+            except _DivergenceDetected as d:
+                self._rollback(d)
+
+    def _epoch_pass(self, epoch: int) -> None:
         """Sync-free hot loop: the only host↔device round-trips are the
         lagged fetches inside ``self.deferred`` (entries ≥ metrics_lag
         steps old, already resolved) — never the in-flight step."""
@@ -399,6 +457,9 @@ class Trainer:
         n_iter = len(self.train_loader)
         t_data = time.time()
         batches = iter(self.train_loader)
+        # kept for the rollback path: an abandoned pass must shut its
+        # prefetch pipeline down instead of leaking the worker thread
+        self._batches = batches
         it = 0
         while True:
             # data-wait phase: host blocked on the (possibly prefetched)
@@ -418,12 +479,29 @@ class Trainer:
             data_time = loader_wait if loader_wait is not None else \
                 wall_wait
             self.callbacks.fire("before_iter", self, batch=batch)
+            # recovery hooks, dispatched BEFORE the (possibly donating)
+            # step consumes the state buffers: the periodic device-side
+            # anchor snapshot, and — inside a post-rollback cooldown —
+            # a params copy for the damped update below
+            prev_params = cooldown = None
+            if self._recovery is not None:
+                self._recovery.maybe_snapshot(self.host_step, self.state)
+                cooldown = self._recovery.cooldown_scale(self.host_step)
+                if cooldown is not None:
+                    prev_params = recovery_mod.snapshot_state(
+                        self.state.params)
             # dispatch phase: enqueue the jitted step (async — this span
             # measures host dispatch, not device compute; StepTrace-
             # annotated so a concurrent XLA trace aligns device ops)
             with step_span("dispatch", self.host_step):
                 self.state, metrics = self.train_step(self.state, batch,
                                                       self.rng)
+            if cooldown is not None:
+                # shrink this step's param delta (exact LR decay for
+                # SGD); optimizer moments keep their own schedule
+                self.state = self.state.replace(
+                    params=recovery_mod.damp_update(
+                        prev_params, self.state.params, cooldown))
             self.callbacks.fire("after_iter", self, metrics=metrics)
             self._host_step = self.host_step + 1
             self.deferred.push(metrics, epoch=epoch, it=it,
@@ -438,6 +516,11 @@ class Trainer:
             # then land any requested preemption while state is clean
             self._beat_touch("step")
             faults.maybe_fire("step", step=self.host_step)
+            if faults.consume("nan", "step", step=self.host_step):
+                # poison the params so the NEXT step's loss goes NaN
+                # through the real jitted bad_step path — divergence
+                # detection and recovery run end to end, not shortcut
+                self.state = recovery_mod.poison_state(self.state)
             self._check_preempted()
             t_data = time.time()
             it += 1
@@ -472,25 +555,41 @@ class Trainer:
                               epoch=meta.get("epoch"), it=meta.get("it"),
                               data_time=meta.get("data_time"),
                               metrics=host)
-        if self.abort_non_finite:
-            for meta, host in entries:
+        if self._recovery is not None or self.abort_non_finite:
+            bad_i = None
+            for i, (meta, host) in enumerate(entries):
                 # bad_step is the jitted isfinite(loss) flag; the loss
                 # check is the fallback for custom steps that don't
                 # provide it (non-finite params keep it latched anyway)
                 if host.get("bad_step", 0) > 0 or not np.isfinite(
                         host.get("loss", 0.0)):
-                    self.logger.error(
-                        f"Loss is {host.get('loss')}, stopping training "
-                        f"(epoch {meta['epoch']} it {meta['it']})")
-                    if self.obs_enabled:
-                        flight.record("divergence",
-                                      step=meta.get("step"),
-                                      epoch=meta["epoch"],
-                                      it=meta["it"],
-                                      loss=host.get("loss"))
-                    raise FloatingPointError(
-                        f"non-finite loss {host.get('loss')} at epoch "
-                        f"{meta['epoch']} it {meta['it']}")
+                    bad_i = i
+                    break
+            if self._recovery is not None and bad_i != 0:
+                # the newest verified-finite step vouches for every
+                # pending anchor snapshot strictly older than it
+                clean_meta = entries[len(entries) - 1 if bad_i is None
+                                     else bad_i - 1][0]
+                if clean_meta.get("step") is not None:
+                    self._recovery.mark_verified(clean_meta["step"])
+            if bad_i is not None:
+                meta, host = entries[bad_i]
+                self.logger.error(
+                    f"Loss is {host.get('loss')}, "
+                    + ("recovering" if self._recovery is not None
+                       else "stopping training")
+                    + f" (epoch {meta['epoch']} it {meta['it']})")
+                if self.obs_enabled:
+                    flight.record("divergence",
+                                  step=meta.get("step"),
+                                  epoch=meta["epoch"],
+                                  it=meta["it"],
+                                  loss=host.get("loss"))
+                if self._recovery is not None:
+                    raise _DivergenceDetected(meta, host)
+                raise FloatingPointError(
+                    f"non-finite loss {host.get('loss')} at epoch "
+                    f"{meta['epoch']} it {meta['it']}")
         meta, host = entries[-1]
         host = {k: v for k, v in host.items() if k != "bad_step"}
         host["data_time"] = meta["data_time"]
@@ -500,6 +599,57 @@ class Trainer:
             f"{self.meters}")
         self.hub.scalars({f"train/{k}": v for k, v in host.items()},
                          meta["step"])
+
+    # ---------------------------------------------------------- recovery
+    def _rollback(self, d: _DivergenceDetected) -> None:
+        """Roll back to the anchor, skip the offending data window, and
+        arm the cooldown — or, with the budget spent, fall through to
+        the seed abort path (FloatingPointError, same message shape)."""
+        meta, host = d.meta, d.host
+        bad_step = int(meta.get("step") or self.host_step)
+        # the failed pass's prefetch pipeline must die before we restart
+        close = getattr(self._batches, "close", None)
+        if close is not None:
+            close()
+        try:
+            anchor_step, state = self._recovery.on_divergence(bad_step)
+        except RecoveryExhausted as exc:
+            if self.obs_enabled:
+                flight.record("recovery_exhausted", step=bad_step,
+                              error=str(exc), **self._recovery.stats())
+            raise FloatingPointError(
+                f"non-finite loss {host.get('loss')} at epoch "
+                f"{meta['epoch']} it {meta['it']} ({exc})") from exc
+        self.state = state
+        self._host_step = anchor_step
+        # in-flight entries were computed from poisoned state — replace
+        # the ring instead of materializing them
+        self.deferred = DeferredMetrics(lag=self.metrics_lag,
+                                        window=self.metrics_window or None)
+        # skip the window: a reseed-capable loader replays the epoch
+        # under a fresh permutation, so the poisonous batch order is
+        # never retraced verbatim
+        reseed = getattr(self.train_loader, "reseed", None)
+        if reseed is not None:
+            reseed(self._recovery.rollbacks)
+        pol = self._recovery.policy
+        self.logger.warning(
+            f"divergence at step {bad_step} (loss {host.get('loss')}): "
+            f"rolled back to step {anchor_step}, "
+            + ("reseeded loader, " if reseed is not None else "")
+            + f"lr x{pol.lr_decay} for {pol.cooldown_steps} steps "
+            f"({len(self._recovery.recovery_steps)}/{pol.max_recoveries} "
+            f"recoveries used)")
+        if self.obs_enabled:
+            flight.record("recovery", step=bad_step,
+                          anchor_step=anchor_step, loss=host.get("loss"),
+                          epoch=meta.get("epoch"),
+                          rollbacks=self._recovery.rollbacks,
+                          skipped=[anchor_step, bad_step],
+                          cooldown_steps=pol.cooldown_steps,
+                          lr_decay=pol.lr_decay,
+                          reseeded=reseed is not None)
+        self._beat_touch("recovery")
 
     # -------------------------------------------------------------- eval
     def evaluate(self) -> Dict[str, float]:
@@ -548,6 +698,15 @@ class Trainer:
                            metrics={self.best_metric: self.best_value},
                            is_best=is_best,
                            topology=self._topology())
+        if faults.consume("ckpt_corrupt", "checkpoint", step=step):
+            # flush FIRST so the checksum sidecar records the intact
+            # files — the bit-flip after commit is exactly the silent
+            # on-disk corruption restore-time verification must catch
+            self.ckpt.flush()
+            hit = faults.corrupt_checkpoint(self.ckpt.directory, step)
+            self.logger.warning(
+                f"fault: corrupted checkpoint step {step} "
+                f"({len(hit)} file(s))")
         self.callbacks.fire("on_checkpoint", self, step=step)
 
     def _topology(self) -> Optional[Dict[str, Any]]:

@@ -141,3 +141,44 @@ def obs_overhead(step_fn, args, n=30, reps=3, budget_pct=2.0):
         "within_budget": overhead_pct <= budget_pct,
         "budget_pct": budget_pct,
     }
+
+
+def recovery_overhead(step_fn, args, state, n=30, reps=3, budget_pct=2.0):
+    """A/B the self-healing hooks' IDLE cost: the same ``step_fn(*args)``
+    loop bare vs with the Trainer's per-step recovery hooks — the
+    ``maybe_snapshot`` cadence check and the ``cooldown_scale`` compare
+    — at a cadence that never actually snapshots (anchor_every far past
+    n), which is the steady-state cost every healthy step pays. Same
+    min-of-reps discipline and <=``budget_pct``% contract shape as
+    ``obs_overhead``."""
+    from deeplearning_tpu.train.recovery import (RecoveryManager,
+                                                 RecoveryPolicy)
+
+    mgr = RecoveryManager(RecoveryPolicy(anchor_every=10 ** 9))
+
+    def loop(with_hooks):
+        out = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            if with_hooks:
+                mgr.maybe_snapshot(i, state)
+                mgr.cooldown_scale(i)
+                out = step_fn(*args)
+            else:
+                out = step_fn(*args)
+        sync(out)
+        return time.perf_counter() - t0
+
+    sync(step_fn(*args))           # warmup: compile once
+    off = on = float("inf")
+    for _ in range(reps):
+        off = min(off, loop(False))
+        on = min(on, loop(True))
+    overhead_pct = (on - off) / off * 100.0 if off > 0 else 0.0
+    return {
+        "recovery_off_ms": round(off / n * 1e3, 4),
+        "recovery_on_ms": round(on / n * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "within_budget": overhead_pct <= budget_pct,
+        "budget_pct": budget_pct,
+    }

@@ -148,6 +148,10 @@ def summarize(run_dir: str) -> Dict[str, Any]:
     if restarts:
         out["restarts"] = restarts
 
+    recovery = recovery_summary(flight)
+    if recovery:
+        out["recovery"] = recovery
+
     rows = load_metrics(run_dir)
     if rows:
         steps = [r for r in rows if not r.get("summary")]
@@ -197,6 +201,39 @@ def restart_summary(sup: Optional[Dict[str, Any]],
             out["cross_topology_resumes"] = sum(
                 1 for e in resumes if e.get("cross_topology"))
     return out or None
+
+
+def recovery_summary(child_flight: Optional[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """Self-healing section: what the run survived — divergence
+    rollbacks (with the data windows it skipped), quarantined samples,
+    checkpoint save retries, and corrupt-checkpoint fallbacks. None
+    when the run never needed any of it."""
+    if child_flight is None:
+        return None
+    ev = child_flight.get("events", [])
+
+    def of(kind: str) -> List[Dict[str, Any]]:
+        return [e for e in ev if e.get("kind") == kind]
+
+    rollbacks = of("recovery")
+    out = {
+        "rollbacks": len(rollbacks),
+        "rollback_steps": [int(e.get("step", 0)) for e in rollbacks],
+        "skipped_windows": [e.get("skipped") for e in rollbacks
+                            if e.get("skipped")],
+        "quarantined_samples": len(of("quarantine")),
+        "ckpt_retries": len(of("ckpt_retry")),
+        "ckpt_corrupt": len(of("ckpt_corrupt")),
+        "ckpt_fallbacks": [[int(e.get("from_step", 0)),
+                            int(e.get("to_step", 0))]
+                           for e in of("ckpt_fallback")],
+        "exhausted": len(of("recovery_exhausted")) > 0,
+    }
+    empty = (not rollbacks and not out["quarantined_samples"]
+             and not out["ckpt_retries"] and not out["ckpt_corrupt"]
+             and not out["ckpt_fallbacks"] and not out["exhausted"])
+    return None if empty else out
 
 
 def render(summary: Dict[str, Any]) -> str:
@@ -254,6 +291,20 @@ def render(summary: Dict[str, Any]) -> str:
                 f"resumed at steps {r['resume_steps']} "
                 f"({r['cross_topology_resumes']} cross-topology)")
         lines.append("restarts: " + "; ".join(parts))
+    rec = summary.get("recovery")
+    if rec:
+        lines.append("")
+        lines.append(
+            f"recovery: rollbacks={rec['rollbacks']}"
+            + (f" at steps {rec['rollback_steps']}"
+               if rec["rollback_steps"] else "")
+            + (f" skipped={rec['skipped_windows']}"
+               if rec["skipped_windows"] else "")
+            + f" quarantined={rec['quarantined_samples']}"
+            f" ckpt_retries={rec['ckpt_retries']}"
+            + (f" ckpt_fallbacks={rec['ckpt_fallbacks']}"
+               if rec["ckpt_fallbacks"] else "")
+            + (" EXHAUSTED" if rec.get("exhausted") else ""))
     m = summary.get("metrics")
     if m:
         lines.append("")
@@ -292,6 +343,15 @@ def _check() -> int:
                    saved_topology="data=8", current_topology="data=4")
         rec.record("step", step=2, loss=float("nan"))
         rec.record("divergence", step=2)
+        # self-healing telemetry (PR 7): one survived rollback, one
+        # quarantined sample, one save retry, one corrupt-ckpt fallback
+        rec.record("recovery", step=2, anchor_step=1, loss=float("nan"),
+                   skipped=[1, 2], rollbacks=1)
+        rec.record("quarantine", index=37,
+                   error="ValueError('truncated jpeg')")
+        rec.record("ckpt_retry", step=2, attempt=1,
+                   error="OSError(28, 'No space left')")
+        rec.record("ckpt_fallback", from_step=2, to_step=1)
         rec.configure(os.path.join(run_dir, "flightrec.json"),
                       {"model": "mnist_fcn", "batch": 64})
         assert rec.dump("divergence",
@@ -339,8 +399,16 @@ def _check() -> int:
         assert r["final"] == "completed" and not r["gave_up"], r
         assert r["resume_steps"] == [1], r
         assert r["cross_topology_resumes"] == 1, r
+        rc = summary["recovery"]
+        assert rc["rollbacks"] == 1 and rc["rollback_steps"] == [2], rc
+        assert rc["skipped_windows"] == [[1, 2]], rc
+        assert rc["quarantined_samples"] == 1, rc
+        assert rc["ckpt_retries"] == 1, rc
+        assert rc["ckpt_fallbacks"] == [[2, 1]], rc
+        assert not rc["exhausted"], rc
         for token in ("data_wait", "train_step", "divergence",
-                      "restarts:", "cross-topology"):
+                      "restarts:", "cross-topology", "recovery:",
+                      "quarantined=1"):
             assert token in report, report
     print("obs_report --check: ok")
     return 0

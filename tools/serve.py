@@ -125,16 +125,24 @@ def serve_stdin(batcher, task: str, size: int, names, topk: int,
 
 
 def serve_http(batcher, task: str, size: int, names, topk: int,
-               timeout_s: float, port: int):
+               timeout_s: float, port: int,
+               wedge_deadline_s: float = 30.0):
     """Minimal stdlib HTTP front: POST /predict (.npy body, one image or
-    a batch) → JSON; GET /stats → telemetry. ThreadingHTTPServer gives
-    each request its own thread, so concurrent posts micro-batch."""
+    a batch) → JSON; GET /stats → telemetry; GET /healthz → the health
+    verdict, including the DispatchWatch wedge check (requests queued
+    while the dispatch counter is frozen past ``wedge_deadline_s`` →
+    503 with ``"wedged": true``, so a balancer drains a stuck replica
+    the process itself cannot notice). ThreadingHTTPServer gives each
+    request its own thread, so concurrent posts micro-batch."""
     import io
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from deeplearning_tpu.obs import xla as obs_xla
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
+    from deeplearning_tpu.serve.health import DispatchWatch
     from deeplearning_tpu.serve.health import health as health_check
+
+    watch = DispatchWatch(batcher, wedge_deadline_s)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):   # quiet: telemetry is the log
@@ -157,7 +165,8 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                 payload["hbm"] = obs_xla.hbm_snapshot()
                 return self._json(200, payload)
             if route == "/healthz":
-                code, payload = health_check(batcher.engine, batcher)
+                code, payload = health_check(batcher.engine, batcher,
+                                             wedge=watch)
                 return self._json(code, payload)
             return self._json(404, {"error": "GET /stats or /healthz"})
 
@@ -217,8 +226,12 @@ def main(argv=None) -> int:
     ap.add_argument("--http", type=int, default=None,
                     help="serve HTTP on this port instead of stdin "
                          "(0 = ephemeral)")
+    ap.add_argument("--wedge-deadline-s", type=float, default=30.0,
+                    help="healthz reports wedged after this many seconds "
+                         "of queued-but-frozen dispatch")
     args = ap.parse_args(argv)
 
+    from deeplearning_tpu.elastic import heartbeat as hb
     from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
 
     engine = InferenceEngine(
@@ -234,21 +247,36 @@ def main(argv=None) -> int:
         with open(args.classes) as f:
             names = {int(k): v for k, v in json.load(f).items()}
 
-    with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
-                      max_queue=args.max_queue,
-                      default_timeout_s=args.timeout_s) as batcher:
-        if args.http is not None:
-            server = serve_http(batcher, engine.task, args.size, names,
-                                args.topk, args.timeout_s, args.http)
-            try:
-                server.serve_forever()
-            except KeyboardInterrupt:
-                pass
-            finally:
-                server.server_close()
-            return 0
-        return serve_stdin(batcher, engine.task, args.size, names,
-                           args.topk, args.timeout_s)
+    # supervised serving: when DLTPU_HEARTBEAT names a file (the
+    # supervisor's contract with its children), the batcher's dispatch
+    # loop advances the activity watermark — a wedged replica gets the
+    # same SIGTERM/requeue treatment as a wedged training run
+    beat = writer = None
+    beat_path = os.environ.get(hb.ENV_VAR)
+    if beat_path:
+        beat = hb.Heartbeat()
+        writer = hb.HeartbeatWriter(beat_path, beat).start()
+    try:
+        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+                          max_queue=args.max_queue,
+                          default_timeout_s=args.timeout_s,
+                          heartbeat=beat) as batcher:
+            if args.http is not None:
+                server = serve_http(batcher, engine.task, args.size,
+                                    names, args.topk, args.timeout_s,
+                                    args.http, args.wedge_deadline_s)
+                try:
+                    server.serve_forever()
+                except KeyboardInterrupt:
+                    pass
+                finally:
+                    server.server_close()
+                return 0
+            return serve_stdin(batcher, engine.task, args.size, names,
+                               args.topk, args.timeout_s)
+    finally:
+        if writer is not None:
+            writer.stop()
 
 
 if __name__ == "__main__":

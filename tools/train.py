@@ -85,6 +85,8 @@ class TrainCfg:
     microbatches: int = 0            # pipeline microbatches (0 = stages)
     donate_batch: bool = True        # recycle input HBM buffers per step
     precompile: bool = True          # AOT step compile overlapped w/ feed
+    recovery: str = "none"           # none|abort: raise on divergence;
+                                     # rollback: anchor + skip + cooldown
 
 
 @dataclasses.dataclass(frozen=True)
@@ -326,6 +328,8 @@ def main(argv=None) -> int:
         async_checkpoint=cfg.train.async_checkpoint,
         log_every=max(steps_per_epoch // 2, 1),
         prefetch=cfg.data.prefetch,
+        recovery=(None if cfg.train.recovery in ("none", "")
+                  else cfg.train.recovery),
         # full config into the flight recorder: a flightrec.json from a
         # crashed run identifies the exact run that produced it
         run_config=dataclasses.asdict(cfg))
