@@ -275,6 +275,24 @@ def _recovery_smoke():
     return res
 
 
+def _lint_status():
+    """dltpu-check ratchet verdict for the bench record: a perf number
+    from a tree with NEW policy findings (a stray hot-loop sync, a
+    use-after-donate) is not comparable to the baseline's."""
+    from deeplearning_tpu.analysis import lint
+
+    t0 = time.perf_counter()
+    status = lint.ratchet_status()
+    return {
+        "clean": status["clean"],
+        "findings": status["findings"],
+        "baseline_findings": status["baseline_findings"],
+        "new_groups": status["new_groups"],
+        "files": status["files_scanned"],
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _health_probe():
     """Fail fast if the device is wedged: a tiny matmul + scalar D2H fetch
     must complete within _PROBE_DEADLINE_S, else report and exit instead of
@@ -325,6 +343,11 @@ def _health_probe():
             cpu_fallback["recovery"] = _recovery_smoke()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["recovery"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["lint_clean"] = _lint_status()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["lint_clean"] = {"error": repr(e)}
         progress[0] += 1
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
@@ -450,6 +473,11 @@ def main():
         rec["recovery"] = _recovery_smoke()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["recovery"] = {"error": repr(e)}
+    try:
+        # dltpu-check ratchet: was this number measured on a clean tree?
+        rec["lint_clean"] = _lint_status()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["lint_clean"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

@@ -18,6 +18,8 @@ harnesses, this package provides ONE shared TPU-first core:
 - ``evaluation``  metrics: top-k, confusion-matrix mIoU, dice, COCO/VOC
                 mAP (with a native C++ fast path), CMC/mAP retrieval.
 - ``export``    StableHLO / TF SavedModel export paths.
+- ``analysis``  dltpu-check: AST policy linter with a ratchet baseline,
+                jaxpr structural auditor, runtime strict mode.
 """
 
 __version__ = "0.1.0"
@@ -26,3 +28,4 @@ __version__ = "0.1.0"
 # schedules, ...), so `deeplearning_tpu.core.MODELS.build(name)` works after
 # a bare `import deeplearning_tpu`.
 from . import core, ops, parallel, data, train, models, evaluation  # noqa: E402,F401
+from . import analysis  # noqa: E402,F401  (lint is stdlib-only; jaxpr/strict lazy)

@@ -1,0 +1,26 @@
+"""dltpu-check: static TPU-policy linter, jaxpr structural auditor, and
+runtime strict mode.
+
+``lint`` is stdlib-only and imported eagerly (it must stay usable from
+processes that never import jax — ``tools/check.py --ci`` loads it
+standalone for exactly that reason). ``jaxpr`` and ``strict`` import
+jax, so they resolve lazily on first attribute access.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from . import lint  # noqa: F401  (stdlib-only, safe eager)
+
+_LAZY = ("jaxpr", "strict")
+
+__all__ = ["lint", "jaxpr", "strict"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,258 @@
+"""Structural jaxpr auditor: reusable invariant checks over traced fns.
+
+``tests/test_blocked_nms.py`` proved the no-N×N-memory claim by walking
+the jaxpr inline; that walk is the general tool for every structural
+invariant this repo cares about — peak intermediate size (does the
+postprocess really stay O(N·B)?), transfer counts (does the train step
+really dispatch zero ``device_put``s?), and collective counts (what does
+a sharded step actually all-reduce? — the accounting PAPERS.md
+"Automatic Cross-Replica Sharding of Weight Update" and "EQuARX"
+optimizations start from). This module is that walk, shared: usable from
+any test and from ``tools/check.py --jaxpr`` over the registered
+step/postprocess functions.
+
+Everything reasons over ``jax.make_jaxpr`` output — tracing only, no
+compile, no device execution — so audits are cheap even on the 1-core
+build box.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "iter_eqns", "iter_avals", "peak_intermediate",
+    "assert_peak_intermediate_below", "count_primitive",
+    "count_transfers", "count_collectives", "Audit", "builtin_audits",
+    "run_audits",
+]
+
+# primitives that move bytes between host and device (or between
+# devices) when they appear inside a traced computation
+TRANSFER_PRIMITIVES = ("device_put", "copy")
+
+# cross-replica communication primitives (jax.lax collectives + the
+# names GSPMD lowers shard_map bodies to)
+COLLECTIVE_PRIMITIVES = (
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "reduce_scatter", "psum_scatter",
+    "pbroadcast", "allreduce",
+)
+
+
+def _as_jaxpr(obj):
+    """Accept Jaxpr, ClosedJaxpr, or anything with a ``.jaxpr``."""
+    if hasattr(obj, "eqns"):
+        return obj
+    if hasattr(obj, "jaxpr"):
+        return obj.jaxpr
+    raise TypeError(f"not a jaxpr: {type(obj)!r}")
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Every equation in ``jaxpr`` and all nested sub-jaxprs (pjit
+    bodies, scan/while/cond branches, custom_* calls)."""
+    jaxpr = _as_jaxpr(jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for p in eqn.params.values():
+            if hasattr(p, "eqns") or hasattr(p, "jaxpr"):
+                yield from iter_eqns(p)
+            elif isinstance(p, (tuple, list)):
+                for q in p:
+                    if hasattr(q, "eqns") or hasattr(q, "jaxpr"):
+                        yield from iter_eqns(q)
+
+
+def iter_avals(jaxpr) -> Iterable[Any]:
+    """Abstract values of every equation OUTPUT, nested jaxprs included
+    — the exact set the original inline walk in test_blocked_nms.py
+    measured (inputs/consts excluded), kept identical so ported bounds
+    stay bitwise the same."""
+    for eqn in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            yield v.aval
+
+
+def _trace(fn: Callable, *args, **kwargs):
+    return jax.make_jaxpr(fn)(*args, **kwargs)
+
+
+def peak_intermediate(fn: Callable, *args, **kwargs) -> int:
+    """Largest intermediate (in ELEMENTS, not bytes) any equation in the
+    traced ``fn(*args)`` produces; 0 when there are no shaped outputs.
+    Scalars count as 1 element (``prod(()) == 1``)."""
+    closed = _trace(fn, *args, **kwargs)
+    return max((int(math.prod(a.shape)) for a in iter_avals(closed.jaxpr)
+                if getattr(a, "shape", None) is not None), default=0)
+
+
+def assert_peak_intermediate_below(fn: Callable, args: Tuple,
+                                   max_elements: int,
+                                   msg: str = "") -> int:
+    """Assert the traced ``fn(*args)`` never materializes an
+    intermediate above ``max_elements`` elements. Returns the measured
+    peak so callers can report/log it."""
+    peak = peak_intermediate(fn, *args)
+    assert peak <= max_elements, (
+        f"peak intermediate {peak} elements exceeds budget "
+        f"{max_elements}" + (f" ({msg})" if msg else ""))
+    return peak
+
+
+def count_primitive(fn: Callable, name, *args, **kwargs) -> int:
+    """Occurrences of primitive(s) ``name`` (a str or tuple of strs) in
+    the traced ``fn(*args)``, nested jaxprs included."""
+    names = (name,) if isinstance(name, str) else tuple(name)
+    closed = _trace(fn, *args, **kwargs)
+    return sum(1 for eqn in iter_eqns(closed.jaxpr)
+               if eqn.primitive.name in names)
+
+
+def count_transfers(fn: Callable, *args, **kwargs) -> int:
+    """Host/device transfer primitives inside the traced computation.
+    The sync-free hot-loop contract says this is 0 for the train step:
+    batches arrive placed (DevicePrefetcher) and metrics leave lazily
+    (DeferredMetrics), so nothing inside the step moves bytes itself."""
+    return count_primitive(fn, TRANSFER_PRIMITIVES, *args, **kwargs)
+
+
+def count_collectives(fn: Callable, *args,
+                      axis_env: Optional[List[Tuple[str, int]]] = None,
+                      **kwargs) -> Dict[str, int]:
+    """Per-primitive counts of cross-replica collectives in the traced
+    ``fn(*args)`` — ``{"psum": 2, "all_gather": 1}``-shaped; empty when
+    the computation is collective-free. ``axis_env`` names mapped axes
+    for functions that psum over an axis outside pmap/shard_map (same
+    contract as ``jax.make_jaxpr``'s)."""
+    mk = jax.make_jaxpr(fn, axis_env=axis_env) if axis_env else \
+        jax.make_jaxpr(fn)
+    closed = mk(*args, **kwargs)
+    out: Dict[str, int] = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        nm = eqn.primitive.name
+        if nm in COLLECTIVE_PRIMITIVES:
+            out[nm] = out.get(nm, 0) + 1
+    return out
+
+
+# --------------------------------------------------------------- audits
+class Audit:
+    """One registered structural check for ``tools/check.py --jaxpr``:
+    trace ``fn(*args)``, measure peak/transfers/collectives, compare to
+    the declared budgets. ``max_elements=None`` means unbounded (the
+    reference rows exist to show the auditor SEES the blow-up)."""
+
+    def __init__(self, name: str, fn: Callable, args: Tuple, *,
+                 max_elements: Optional[int] = None,
+                 max_transfers: Optional[int] = 0,
+                 min_elements: Optional[int] = None,
+                 note: str = ""):
+        self.name = name
+        self.fn = fn
+        self.args = args
+        self.max_elements = max_elements
+        self.max_transfers = max_transfers
+        self.min_elements = min_elements
+        self.note = note
+
+    def run(self) -> Dict[str, Any]:
+        row: Dict[str, Any] = {"name": self.name, "note": self.note}
+        try:
+            row["peak_elements"] = peak_intermediate(self.fn, *self.args)
+            row["transfers"] = count_transfers(self.fn, *self.args)
+            row["collectives"] = count_collectives(self.fn, *self.args)
+            ok = True
+            if self.max_elements is not None:
+                row["budget_elements"] = self.max_elements
+                ok &= row["peak_elements"] <= self.max_elements
+            if self.min_elements is not None:
+                ok &= row["peak_elements"] >= self.min_elements
+            if self.max_transfers is not None:
+                ok &= row["transfers"] <= self.max_transfers
+            row["ok"] = bool(ok)
+        except Exception as e:  # noqa: BLE001 - a broken audit must report
+            row["ok"] = False
+            row["error"] = repr(e)
+        return row
+
+
+def builtin_audits() -> List[Audit]:
+    """The registered step/postprocess functions with their structural
+    budgets — the tentpole invariants, re-checkable on demand:
+
+    - blocked NMS stays O(N·B) (the test_blocked_nms bound, N=4096);
+    - the reference NMS row PROVES the auditor sees an N×N blow-up;
+    - one-pass RoIAlign does <=8 gathers (one sampling pass);
+    - the mnist train step traces with zero transfer primitives (the
+      PR 1 sync-free contract, structural form).
+    """
+    import jax.numpy as jnp
+
+    from ..ops import nms as nms_ops
+    from ..ops import roi_align as roi_ops
+
+    audits: List[Audit] = []
+    n, block = 4096, 256
+    boxes = jnp.zeros((n, 4))
+    scores = jnp.zeros((n,))
+    audits.append(Audit(
+        f"nms_blocked_n{n}",
+        partial(nms_ops.nms_blocked, iou_threshold=0.5, max_out=100,
+                block_size=block),
+        (boxes, scores),
+        max_elements=4 * n * block,
+        note=f"O(N*B) budget, B={block}"))
+    audits.append(Audit(
+        f"nms_reference_n{n}",
+        partial(nms_ops.nms_reference, iou_threshold=0.5, max_out=100),
+        (boxes, scores),
+        min_elements=n * n,
+        note="control: auditor must SEE the N^2 buffer"))
+
+    pyr = {f"p{lv}": jnp.zeros((64 >> (lv - 2), 64 >> (lv - 2), 8))
+           for lv in (2, 3, 4, 5)}
+    rois = jnp.zeros((16, 4))
+    audits.append(Audit(
+        "roi_align_onepass",
+        partial(roi_ops.multiscale_roi_align),
+        (pyr, rois),
+        note="one-pass multiscale gather"))
+
+    def train_step_audit() -> Audit:
+        from ..core.registry import MODELS
+        from ..train import TrainState, make_train_step
+        from ..train.classification import make_loss_fn
+        from ..train.optim import build_optimizer
+        from ..train.schedules import build_schedule
+
+        model = MODELS.build("mnist_fcn", num_classes=4,
+                             dtype=jnp.float32)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, 16, 16, 1)))["params"]
+        tx = build_optimizer("sgd", build_schedule("constant",
+                                                   base_lr=1e-2),
+                             params=params)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        batch = {"image": jnp.zeros((8, 16, 16, 1)),
+                 "label": jnp.zeros((8,), jnp.int32)}
+        step = make_train_step(make_loss_fn(), donate=False)
+        rng = jax.random.key(0)
+        return Audit("train_step_mnist", step, (state, batch, rng),
+                     max_transfers=0,
+                     note="hot-loop step: zero transfer primitives")
+
+    audits.append(train_step_audit())
+    return audits
+
+
+def run_audits(audits: Optional[List[Audit]] = None
+               ) -> List[Dict[str, Any]]:
+    if audits is None:
+        audits = builtin_audits()
+    return [a.run() for a in audits]

@@ -1,0 +1,144 @@
+"""Runtime strict mode: JAX's own sanitizers scoped to our hot loops.
+
+The static linter (``analysis/lint.py``) and the jaxpr auditor
+(``analysis/jaxpr.py``) reason about code; this module arms the runtime.
+``Trainer(strict="transfers")`` (or ``DLTPU_STRICT=1`` in the
+environment) wraps every hot-loop step region in
+``jax.transfer_guard_device_to_host("disallow")``, turning the "≤1 sync
+per log window" claim from a counter-based test into a hard runtime
+error at the exact offending line. ``strict="nans"`` arms
+``jax_debug_nans`` for the whole run (composes with the
+``train/recovery.py`` fault injection: the injected NaN is caught at the
+emitting primitive instead of steps later in the metrics ring).
+
+Caveat the tests rely on: the CPU backend shares one address space with
+the host, so device→host "transfers" are zero-copy views and the d2h
+guard NEVER fires there — it has teeth on TPU/GPU only. Host→device
+transfers DO copy on CPU and the h2d guard raises even there.
+``guard_enforced(kind)`` probes the running backend so tests can skip
+negative cases the backend cannot enforce.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import FrozenSet, Iterator, Optional, Union
+
+import jax
+
+__all__ = [
+    "MODES", "resolve", "no_host_transfers", "no_transfers",
+    "debug_nans", "strict_section", "guard_enforced", "StrictError",
+]
+
+MODES = ("transfers", "nans")
+
+# what a bare opt-in ("1", "true", "on", "all") arms
+_DEFAULT_MODES = frozenset({"transfers"})
+
+StrictError = jax.errors.JaxRuntimeError
+
+
+def resolve(value: Union[str, bool, None] = None,
+            env: str = "DLTPU_STRICT") -> FrozenSet[str]:
+    """Normalize a strict spec into the set of armed modes.
+
+    ``value`` wins when given (``True``/``"1"`` → transfers;
+    ``"transfers,nans"``/``"all"`` → both; ``False``/``""``/``"0"`` →
+    none); otherwise the ``DLTPU_STRICT`` env var is consulted so any
+    entry point gains strict mode without a code change.
+    """
+    if value is None:
+        value = os.environ.get(env, "")
+    if isinstance(value, bool):
+        return _DEFAULT_MODES if value else frozenset()
+    value = str(value).strip().lower()
+    if value in ("", "0", "false", "off", "none"):
+        return frozenset()
+    if value in ("1", "true", "on"):
+        return _DEFAULT_MODES
+    if value == "all":
+        return frozenset(MODES)
+    modes = frozenset(m.strip() for m in value.split(",") if m.strip())
+    unknown = modes - frozenset(MODES)
+    if unknown:
+        raise ValueError(
+            f"unknown strict mode(s) {sorted(unknown)}; "
+            f"valid: {MODES}, '1'/'all', or ''")
+    return modes
+
+
+@contextlib.contextmanager
+def no_transfers(kind: str = "device_to_host") -> Iterator[None]:
+    """Disallow implicit ``kind`` transfers inside the block.
+    ``kind`` ∈ {"device_to_host", "host_to_device", "all"}."""
+    if kind == "device_to_host":
+        ctx = jax.transfer_guard_device_to_host("disallow")
+    elif kind == "host_to_device":
+        ctx = jax.transfer_guard_host_to_device("disallow")
+    elif kind == "all":
+        ctx = jax.transfer_guard("disallow")
+    else:
+        raise ValueError(f"unknown transfer kind {kind!r}")
+    with ctx:
+        yield
+
+
+def no_host_transfers() -> "contextlib.AbstractContextManager[None]":
+    """The hot-loop guard: any device→host materialization inside the
+    block (``.item()``, ``np.asarray``, float(), implicit printing)
+    raises instead of silently stalling the dispatch pipeline."""
+    return no_transfers("device_to_host")
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True) -> Iterator[None]:
+    """Arm ``jax_debug_nans`` inside the block (restores the previous
+    setting on exit). Under this flag XLA re-runs any computation that
+    produced a NaN in op-by-op mode and raises at the emitting
+    primitive — expensive, so opt-in via ``strict='nans'`` only."""
+    prev = jax.config.jax_debug_nans
+    try:
+        jax.config.update("jax_debug_nans", bool(enable))
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+@contextlib.contextmanager
+def strict_section(modes: FrozenSet[str]) -> Iterator[None]:
+    """The per-step guard region the Trainer wraps around its hot loop.
+    Only the transfer guard applies per-section (debug_nans is armed
+    run-wide by the Trainer because it changes compiled artifacts)."""
+    if "transfers" in modes:
+        with no_host_transfers():
+            yield
+    else:
+        yield
+
+
+def guard_enforced(kind: str = "device_to_host",
+                   backend: Optional[str] = None) -> bool:
+    """Does the running backend actually raise on a disallowed ``kind``
+    transfer?  CPU's zero-copy D2H path makes the d2h guard inert there;
+    tests use this probe to skip negative assertions the backend cannot
+    produce."""
+    import jax.numpy as jnp
+    try:
+        if kind == "device_to_host":
+            x = jnp.arange(4)
+            jax.block_until_ready(x)
+            with no_transfers(kind):
+                float(x[0])  # must attempt a real D2H materialization
+        elif kind == "host_to_device":
+            import numpy as np
+            with no_transfers(kind):
+                # must be an IMPLICIT transfer: explicit jax.device_put
+                # is always allowed under "disallow"
+                jnp.add(np.ones(2), 1.0)
+        else:
+            raise ValueError(f"unknown transfer kind {kind!r}")
+        return False
+    except Exception:  # noqa: BLE001 - any raise means the guard works
+        return True

@@ -134,7 +134,7 @@ class DevicePrefetcher:
         def put(x):
             if isinstance(x, jax.Array):
                 return x                      # already placed — no copy
-            x = np.asarray(x)
+            x = np.asarray(x)  # dltpu: allow(DLT100) H2D staging, worker thread
             if self.mesh is not None:
                 return make_global_array(x, self.mesh, self.spec)
             if self.sharding is not None:

@@ -92,7 +92,7 @@ def _dispatch(signum: int, frame) -> None:
         try:
             fn(signum, frame)
         except Exception:  # noqa: BLE001 - handlers must not cascade
-            pass
+            pass  # dltpu: allow(DLT104) a failing subscriber must not starve the rest
         graceful = graceful or g
     if graceful:
         return                        # the owner exits at a safe boundary

@@ -227,7 +227,7 @@ class InferenceEngine:
         bucket, runs, slices padding away; oversize inputs chunk through
         the largest bucket. The dynamic-batching request path is
         ``serve.batcher.MicroBatcher`` — this is the one-shot surface."""
-        images = np.asarray(images, np.float32)
+        images = np.asarray(images, np.float32)  # dltpu: allow(DLT100) host input
         if images.ndim == 3:
             images = images[None]
         n = images.shape[0]

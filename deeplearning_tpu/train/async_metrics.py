@@ -133,9 +133,11 @@ class DeferredMetrics:
         # one bulk transfer for every ready tree: a poll is ONE sync
         # event regardless of how many steps it covers
         if self.window is None:
+            # dltpu: allow(DLT100) THE designed sync: one lagged bulk fetch
             host_trees = jax.device_get([tree for _, tree in entries])
             return [(meta, {k: float(v) for k, v in host.items()})
                     for (meta, _), host in zip(entries, host_trees)]
+        # dltpu: allow(DLT100) THE designed sync: one fetch per closed window
         host_trees = jax.device_get([acc for _, acc, _, _ in entries])
         out: List[Entry] = []
         for (meta, _, n, _), host in zip(entries, host_trees):

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import rng as rng_mod
+from ..obs import flight
 from ..parallel.sharding import batch_spec, shard_params_tree, Rules
 from .state import TrainState
 
@@ -186,8 +187,12 @@ def shard_state(state: TrainState, mesh: Mesh,
         try:
             if jax.tree.structure(opt) == param_treedef:
                 return param_sh
-        except Exception:
-            pass
+        except (TypeError, ValueError) as e:
+            # an un-flattenable field falls back to replicated — fine,
+            # but leave a trace: a silently-replicated optimizer state
+            # is exactly the HBM regression DLT104 exists to catch
+            flight.record("shard_opt_fallback", field=type(opt).__name__,
+                          error=repr(e))
         return jax.tree.map(lambda x: rep, opt)
 
     shardings = state.replace(

@@ -15,6 +15,7 @@ in the jitted step functions it is given.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from collections import defaultdict
@@ -23,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..analysis import strict as strict_mod
 from ..core import rng as rng_mod
 from ..core.checkpoint import CheckpointManager
 from ..core.logging import (LoggerHub, MetricLogger,
@@ -102,8 +104,17 @@ class Trainer:
         preemptible: bool = True,
         heartbeat="auto",
         recovery=None,
+        strict=None,
     ):
         self.state = state
+        # strict mode (README "Hot-loop sync policy"): arm JAX's own
+        # sanitizers. "transfers" wraps every hot-loop step region in
+        # transfer_guard_device_to_host("disallow") — a stray sync
+        # between log points becomes a runtime error at the offending
+        # line instead of a silent stall. "nans" arms jax_debug_nans
+        # for the whole run. None defers to DLTPU_STRICT in the env.
+        self.strict_modes = strict_mod.resolve(strict)
+        self.strict_sections = 0     # guard regions entered (test hook)
         # self-healing policy (README "Self-healing policy"): None/"abort"
         # keeps the seed behavior (abort_non_finite raises on the first
         # bad step); "rollback" (or a RecoveryPolicy / RecoveryManager)
@@ -371,10 +382,23 @@ class Trainer:
             flight.dump("preempted", exception=exc)
 
     # ------------------------------------------------------------- train
+    def _strict_ctx(self):
+        """One hot-loop guard region (see ``analysis.strict``). Counted
+        so tests can assert the guard really wrapped every step."""
+        if "transfers" in self.strict_modes:
+            self.strict_sections += 1
+            return strict_mod.no_host_transfers()
+        return contextlib.nullcontext()
+
     def train(self) -> Any:
         self._obs_start()
         self._elastic_start()
         try:
+            if "nans" in self.strict_modes:
+                # run-wide, not per-section: jax_debug_nans changes what
+                # XLA compiles, so toggling it per step would retrace
+                with strict_mod.debug_nans():
+                    return self._train()
             return self._train()
         except Preempted as exc:
             self._on_preempted(exc)
@@ -478,35 +502,45 @@ class Trainer:
                                   None)
             data_time = loader_wait if loader_wait is not None else \
                 wall_wait
-            self.callbacks.fire("before_iter", self, batch=batch)
-            # recovery hooks, dispatched BEFORE the (possibly donating)
-            # step consumes the state buffers: the periodic device-side
-            # anchor snapshot, and — inside a post-rollback cooldown —
-            # a params copy for the damped update below
-            prev_params = cooldown = None
-            if self._recovery is not None:
-                self._recovery.maybe_snapshot(self.host_step, self.state)
-                cooldown = self._recovery.cooldown_scale(self.host_step)
+            # strict region: under Trainer(strict="transfers") /
+            # DLTPU_STRICT=1 everything from before_iter through the
+            # deferred push runs under a d2h transfer-guard — the lagged
+            # metrics poll below stays OUTSIDE it, because that fetch is
+            # the one designed sync per log window
+            with self._strict_ctx():
+                self.callbacks.fire("before_iter", self, batch=batch)
+                # recovery hooks, dispatched BEFORE the (possibly
+                # donating) step consumes the state buffers: the periodic
+                # device-side anchor snapshot, and — inside a
+                # post-rollback cooldown — a params copy for the damped
+                # update below
+                prev_params = cooldown = None
+                if self._recovery is not None:
+                    self._recovery.maybe_snapshot(self.host_step,
+                                                  self.state)
+                    cooldown = self._recovery.cooldown_scale(
+                        self.host_step)
+                    if cooldown is not None:
+                        prev_params = recovery_mod.snapshot_state(
+                            self.state.params)
+                # dispatch phase: enqueue the jitted step (async — this
+                # span measures host dispatch, not device compute;
+                # StepTrace-annotated so a concurrent XLA trace aligns
+                # device ops)
+                with step_span("dispatch", self.host_step):
+                    self.state, metrics = self.train_step(
+                        self.state, batch, self.rng)
                 if cooldown is not None:
-                    prev_params = recovery_mod.snapshot_state(
-                        self.state.params)
-            # dispatch phase: enqueue the jitted step (async — this span
-            # measures host dispatch, not device compute; StepTrace-
-            # annotated so a concurrent XLA trace aligns device ops)
-            with step_span("dispatch", self.host_step):
-                self.state, metrics = self.train_step(self.state, batch,
-                                                      self.rng)
-            if cooldown is not None:
-                # shrink this step's param delta (exact LR decay for
-                # SGD); optimizer moments keep their own schedule
-                self.state = self.state.replace(
-                    params=recovery_mod.damp_update(
-                        prev_params, self.state.params, cooldown))
-            self.callbacks.fire("after_iter", self, metrics=metrics)
-            self._host_step = self.host_step + 1
-            self.deferred.push(metrics, epoch=epoch, it=it,
-                               step=self.host_step, n_iter=n_iter,
-                               data_time=data_time)
+                    # shrink this step's param delta (exact LR decay for
+                    # SGD); optimizer moments keep their own schedule
+                    self.state = self.state.replace(
+                        params=recovery_mod.damp_update(
+                            prev_params, self.state.params, cooldown))
+                self.callbacks.fire("after_iter", self, metrics=metrics)
+                self._host_step = self.host_step + 1
+                self.deferred.push(metrics, epoch=epoch, it=it,
+                                   step=self.host_step, n_iter=n_iter,
+                                   data_time=data_time)
             if it % self.log_every == 0:
                 with span("metrics_flush"):
                     self._consume(self.deferred.poll())
@@ -662,6 +696,7 @@ class Trainer:
             per_batch = [self.eval_step(self.state, batch)
                          for batch in self.eval_loader]
             # the one materialization
+            # dltpu: allow(DLT100) designed: single bulk D2H per eval pass
             host_counts = jax.device_get(per_batch)
         self._beat_touch("eval")
         self.eval_fetches += 1
@@ -781,7 +816,7 @@ class Trainer:
         total = time.perf_counter() - t0
         ips = bsz * n_iters / total
         step_times = np.diff(lag_marks) if len(lag_marks) > 1 else \
-            np.asarray([total / n_iters])
+            np.asarray([total / n_iters])  # dltpu: allow(DLT100) host floats
         p50, p90 = np.percentile(step_times, [50, 90])
         data_frac = sum(data_times) / total if total else 0.0
         self.throughput_stats = {

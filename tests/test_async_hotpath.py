@@ -339,3 +339,49 @@ class TestCompileCache:
         monkeypatch.setenv("DLTPU_COMPILE_CACHE", "off")
         monkeypatch.setattr(cc, "_enabled_dir", None)
         assert cc.enable_compile_cache() is None
+
+
+class TestStrictHotLoop:
+    """Runtime proof of the sync-free claim (ISSUE 8): the counter-based
+    tests above show ≤1 fetch per window; these run the same 5-step loop
+    with ``analysis.strict``'s transfer-guard armed, so ANY stray D2H
+    between log points would raise at the offending line."""
+
+    def test_five_steps_under_dltpu_strict(self, monkeypatch):
+        """Acceptance: 5-step CPU smoke under DLTPU_STRICT=1 passes with
+        zero disallowed transfers between log points — every step region
+        ran inside a guard section and the one designed sync (the lagged
+        epoch-end drain) stayed outside them."""
+        monkeypatch.setenv("DLTPU_STRICT", "1")
+        trainer = make_trainer(epochs=1, log_every=100, n=5 * 16, batch=16)
+        assert trainer.strict_modes == frozenset({"transfers"})
+        trainer.train()
+        assert trainer.strict_sections == 5   # guard wrapped every step
+        assert trainer.deferred.fetched_entries == 5
+        assert trainer.deferred.fetch_count <= 1
+
+    def test_arg_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("DLTPU_STRICT", "1")
+        trainer = make_trainer(epochs=1, log_every=100, n=16, batch=16,
+                               strict=False)
+        assert trainer.strict_modes == frozenset()
+        trainer.train()
+        assert trainer.strict_sections == 0
+
+    def test_stray_sync_raises_when_enforced(self):
+        """Negative case: a callback that materializes the in-flight
+        metrics inside the guard region must raise. Only runnable where
+        the backend enforces the d2h guard (CPU's zero-copy D2H is
+        exempt from it, so this is a TPU/GPU-only teeth check)."""
+        from deeplearning_tpu.analysis import strict
+        from deeplearning_tpu.train.trainer import Callbacks
+        if not strict.guard_enforced("device_to_host"):
+            pytest.skip("backend does not enforce the d2h transfer "
+                        "guard (CPU zero-copy)")
+        cb = Callbacks()
+        cb.register("after_iter",
+                    lambda tr, metrics=None: float(metrics["loss"]))
+        trainer = make_trainer(epochs=1, log_every=100, n=16, batch=16,
+                               strict="transfers", callbacks=cb)
+        with pytest.raises(Exception):
+            trainer.train()

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from deeplearning_tpu.analysis import jaxpr as ana_jaxpr
 from deeplearning_tpu.ops import nms as nms_ops
 from deeplearning_tpu.ops import roi_align as roi_ops
 from deeplearning_tpu.ops.pallas import nms as pallas_nms
@@ -171,48 +172,34 @@ class TestPallasEquivalence:
         assert int(valid.sum()) == 1 and int(idx[0]) == 99
 
 
-def _iter_avals(jaxpr):
-    for eqn in jaxpr.eqns:
-        for v in eqn.outvars:
-            yield v.aval
-        for p in eqn.params.values():
-            sub = [p] if hasattr(p, "jaxpr") else \
-                [q for q in p if hasattr(q, "jaxpr")] \
-                if isinstance(p, (tuple, list)) else []
-            for s in sub:
-                yield from _iter_avals(s.jaxpr)
-
-
 class TestMemory:
+    """The inline jaxpr walk these tests used to carry now lives in
+    ``analysis.jaxpr`` (one implementation, same bounds) — the linter's
+    sibling auditor, also run by ``tools/check.py --jaxpr``."""
+
     def test_no_nxn_intermediate(self):
         """The blocked path's biggest intermediate is O(N*B), never N^2."""
         n, block = 4096, 256
         boxes = jnp.zeros((n, 4))
         scores = jnp.zeros((n,))
-        closed = jax.make_jaxpr(functools.partial(
-            nms_ops.nms_blocked, iou_threshold=0.5, max_out=100,
-            block_size=block))(boxes, scores)
-        biggest = max((int(np.prod(a.shape)) for a in _iter_avals(
-            closed.jaxpr) if getattr(a, "shape", None)), default=0)
+        biggest = ana_jaxpr.assert_peak_intermediate_below(
+            functools.partial(nms_ops.nms_blocked, iou_threshold=0.5,
+                              max_out=100, block_size=block),
+            (boxes, scores), 4 * n * block, msg="O(N*B) budget")
         assert biggest < n * n // 2, \
             f"blocked NMS materializes a near-N^2 buffer ({biggest})"
-        assert biggest <= 4 * n * block, \
-            f"peak intermediate {biggest} exceeds O(N*B) budget"
         # sanity: the checker DOES see the reference's N x N buffer
-        closed_ref = jax.make_jaxpr(functools.partial(
-            nms_ops.nms_reference, iou_threshold=0.5,
-            max_out=100))(boxes, scores)
-        biggest_ref = max(int(np.prod(a.shape)) for a in _iter_avals(
-            closed_ref.jaxpr) if getattr(a, "shape", None))
+        biggest_ref = ana_jaxpr.peak_intermediate(
+            functools.partial(nms_ops.nms_reference, iou_threshold=0.5,
+                              max_out=100), boxes, scores)
         assert biggest_ref >= n * n
 
     def test_pallas_wrapper_no_nxn(self):
         n = 2048
-        closed = jax.make_jaxpr(functools.partial(
-            pallas_nms.nms_pallas, iou_threshold=0.5, max_out=100,
-            block_size=256))(jnp.zeros((n, 4)), jnp.zeros((n,)))
-        biggest = max(int(np.prod(a.shape)) for a in _iter_avals(
-            closed.jaxpr) if getattr(a, "shape", None))
+        biggest = ana_jaxpr.peak_intermediate(
+            functools.partial(pallas_nms.nms_pallas, iou_threshold=0.5,
+                              max_out=100, block_size=256),
+            jnp.zeros((n, 4)), jnp.zeros((n,)))
         assert biggest < n * n // 2
 
 
@@ -298,21 +285,7 @@ class TestRoIAlignOnePass:
         pyr, rois = self._pyramid_and_rois(r=50)
 
         def count_gathers(fn):
-            closed = jax.make_jaxpr(fn)(rois)
-            cnt = 0
-            stack = [closed.jaxpr]
-            while stack:
-                j = stack.pop()
-                for eqn in j.eqns:
-                    if eqn.primitive.name == "gather":
-                        cnt += 1
-                    for p in eqn.params.values():
-                        if hasattr(p, "jaxpr"):
-                            stack.append(p.jaxpr)
-                        elif isinstance(p, (tuple, list)):
-                            stack.extend(q.jaxpr for q in p
-                                         if hasattr(q, "jaxpr"))
-            return cnt
+            return ana_jaxpr.count_primitive(fn, "gather", rois)
 
         n_one = count_gathers(
             lambda q: roi_ops.multiscale_roi_align(pyr, q))

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""dltpu-check: the repo's policy gate.
+
+  python tools/check.py                    # lint, human-readable findings
+  python tools/check.py --ci               # ratchet gate: exit 1 on NEW findings
+  python tools/check.py --update-baseline  # re-record analysis/baseline.json
+  python tools/check.py --rules            # rule table
+  python tools/check.py --jaxpr            # structural audits (imports jax)
+
+The default/``--ci``/``--update-baseline``/``--rules`` paths never
+import jax (``analysis/lint.py`` is loaded standalone by file path, not
+through the ``deeplearning_tpu`` package whose ``__init__`` pulls the
+whole stack) — the lint gate stays a sub-10s pure-CPython pass that CI
+can run before any accelerator is even visible. ``--jaxpr`` traces the
+registered step/postprocess functions and checks their structural
+budgets (peak intermediate elements, transfer primitives), so it does
+import jax; run it with ``JAX_PLATFORMS=cpu`` off-device.
+
+Exit codes: 0 clean, 1 policy findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    """Import analysis/lint.py WITHOUT importing the package (which
+    would drag jax in). sys.modules registration is required: lint.py
+    uses ``from __future__ import annotations`` + dataclasses, and
+    dataclass field resolution looks the module up by name."""
+    path = os.path.join(_REPO, "deeplearning_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_dltpu_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cmd_rules(lint) -> int:
+    width = max(len(r) for r in lint.RULES)
+    for rule, desc in sorted(lint.RULES.items()):
+        print(f"{rule:<{width}}  {desc}")
+    print(f"\nsuppress one site:   # dltpu: allow({min(lint.RULES)})")
+    print("suppress all rules:  # dltpu: allow(*)")
+    return 0
+
+
+def cmd_lint(lint, root: str, baseline_path: str, ci: bool,
+             as_json: bool) -> int:
+    t0 = time.monotonic()
+    findings, n_files = lint.lint_tree(root)
+    baseline = lint.load_baseline(baseline_path)
+    new = lint.new_findings(findings, baseline)
+    dt = time.monotonic() - t0
+    n_baselined = sum(sum(r.values())
+                      for r in baseline.get("counts", {}).values())
+    n_new = sum(g["count"] - g["budget"] for g in new)
+    clean = not new
+
+    if as_json:
+        print(json.dumps({
+            "clean": clean, "files_scanned": n_files,
+            "findings": [str(f) for f in findings],
+            "baseline_findings": n_baselined,
+            "new_groups": new, "new": n_new,
+            "seconds": round(dt, 3),
+        }, indent=2, sort_keys=True))
+        return 0 if clean else 1
+
+    if ci:
+        # the ratchet gate: only findings NOT covered by the baseline fail
+        for grp in new:
+            for f in grp["findings"]:
+                print(f)
+            print(f"  ^ {grp['path']} has {grp['count']}x {grp['rule']} "
+                  f"(baseline allows {grp['budget']}) — fix it, pragma it "
+                  f"with '# dltpu: allow({grp['rule']})', or (for "
+                  f"pre-existing debt only) rerun --update-baseline")
+        verdict = "clean" if clean else f"{n_new} NEW finding(s)"
+        print(f"dltpu-check: {verdict} — {len(findings)} total, "
+              f"{n_baselined} baselined, {n_files} files, {dt:.2f}s")
+        return 0 if clean else 1
+
+    # plain lint: print everything, baselined or not
+    for f in findings:
+        print(f)
+    print(f"dltpu-check: {len(findings)} finding(s) in {n_files} files, "
+          f"{dt:.2f}s ({n_baselined} covered by baseline)")
+    return 0 if clean else 1
+
+
+def cmd_update_baseline(lint, root: str, baseline_path: str) -> int:
+    findings, n_files = lint.lint_tree(root)
+    lint.write_baseline(findings, baseline_path)
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    detail = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
+    print(f"wrote {os.path.relpath(baseline_path, root)}: "
+          f"{len(findings)} finding(s) across {n_files} files"
+          + (f" ({detail})" if detail else ""))
+    return 0
+
+
+def cmd_jaxpr(as_json: bool) -> int:
+    # jax from here on — keep every other path import-free
+    sys.path.insert(0, _REPO)
+    from deeplearning_tpu.analysis import jaxpr as jx
+
+    rows = jx.run_audits()
+    if as_json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+    else:
+        for r in rows:
+            mark = "ok " if r["ok"] else "FAIL"
+            extra = f" budget={r['budget_elements']}" \
+                if "budget_elements" in r else ""
+            if "error" in r:
+                print(f"[{mark}] {r['name']}: {r['error']}")
+                continue
+            col = ",".join(f"{k}x{v}" for k, v in
+                           sorted(r["collectives"].items())) or "-"
+            print(f"[{mark}] {r['name']}: peak={r['peak_elements']}"
+                  f"{extra} transfers={r['transfers']} collectives={col}"
+                  f"  ({r['note']})")
+    bad = [r for r in rows if not r["ok"]]
+    print(f"dltpu-check --jaxpr: {len(rows) - len(bad)}/{len(rows)} "
+          f"audits within budget")
+    return 0 if not bad else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--ci", action="store_true",
+                    help="ratchet gate: fail only on non-baseline findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record analysis/baseline.json from the tree")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the DLT rule table and pragma syntax")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="run the structural jaxpr audits (imports jax)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--root", default=_REPO,
+                    help="tree to scan (default: repo root)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: analysis/baseline.json)")
+    args = ap.parse_args(argv)
+
+    if args.jaxpr:
+        return cmd_jaxpr(args.json)
+
+    lint = _load_lint()
+    baseline = args.baseline or lint.DEFAULT_BASELINE
+    if args.rules:
+        return cmd_rules(lint)
+    if args.update_baseline:
+        return cmd_update_baseline(lint, args.root, baseline)
+    return cmd_lint(lint, args.root, baseline, ci=args.ci,
+                    as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,7 +161,36 @@ def summarize(run_dir: str) -> Dict[str, Any]:
             out["metrics"]["last"] = {
                 k: v for k, v in last.items()
                 if isinstance(v, (int, float)) and k != "time"}
+
+    analysis = analysis_summary()
+    if analysis:
+        out["analysis"] = analysis
     return out
+
+
+def analysis_summary() -> Optional[Dict[str, Any]]:
+    """dltpu-check posture: rules enabled + the committed baseline's
+    size. Reads ``analysis/baseline.json`` only — no tree scan, so the
+    report stays instant; run ``tools/check.py --ci`` for a verdict."""
+    lint_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning_tpu", "analysis",
+        "lint.py")
+    if not os.path.exists(lint_py):
+        return None
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_dltpu_lint_report",
+                                                  lint_py)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod   # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    baseline = mod.load_baseline()
+    b_counts = baseline.get("counts", {})
+    return {
+        "rules": len(mod.RULES),
+        "baseline_findings": sum(sum(r.values())
+                                 for r in b_counts.values()),
+        "baseline_files": len(b_counts),
+    }
 
 
 def restart_summary(sup: Optional[Dict[str, Any]],
@@ -311,6 +340,13 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append(f"metrics.jsonl: {m['rows']} rows"
                      + (f", last step {m['last']}" if m.get("last")
                         else ""))
+    a = summary.get("analysis")
+    if a:
+        lines.append("")
+        lines.append(
+            f"analysis: {a['rules']} DLT rules enabled, baseline "
+            f"{a['baseline_findings']} finding(s) in "
+            f"{a['baseline_files']} file(s) (tools/check.py --ci)")
     return "\n".join(lines)
 
 
@@ -410,6 +446,12 @@ def _check() -> int:
                       "restarts:", "cross-topology", "recovery:",
                       "quarantined=1"):
             assert token in report, report
+        # dltpu-check posture line: rules enabled + committed baseline
+        ana = summary["analysis"]
+        assert ana["rules"] >= 6, ana
+        assert ana["baseline_findings"] >= 0, ana
+        assert "analysis: " in report and "DLT rules enabled" in report, \
+            report
     print("obs_report --check: ok")
     return 0
 

@@ -87,6 +87,8 @@ class TrainCfg:
     precompile: bool = True          # AOT step compile overlapped w/ feed
     recovery: str = "none"           # none|abort: raise on divergence;
                                      # rollback: anchor + skip + cooldown
+    strict: str = ""                 # ""|transfers|nans|all: arm JAX
+                                     # sanitizers (see analysis.strict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -330,6 +332,7 @@ def main(argv=None) -> int:
         prefetch=cfg.data.prefetch,
         recovery=(None if cfg.train.recovery in ("none", "")
                   else cfg.train.recovery),
+        strict=cfg.train.strict or None,
         # full config into the flight recorder: a flightrec.json from a
         # crashed run identifies the exact run that produced it
         run_config=dataclasses.asdict(cfg))
