@@ -275,6 +275,39 @@ def _recovery_smoke():
     return res
 
 
+def _shard_smoke():
+    """ZeRO-1 footprint smoke on the host CPU: shard the mnist adamw
+    state replicated vs zero1 over every visible CPU device and report
+    per-device optimizer-state bytes. Single-device hosts report a
+    ratio of 1.0 — the field still lands so the record shape is stable."""
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.parallel.mesh import MeshConfig, build_mesh
+    from deeplearning_tpu.parallel.sharding import tree_bytes_per_device
+    from deeplearning_tpu.train import TrainState
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+    from deeplearning_tpu.train.steps import shard_state
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    model = MODELS.build("mnist_fcn", num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)),
+                        train=False)["params"]
+
+    def bytes_for(zero1):
+        tx = build_optimizer(
+            "adamw", build_schedule("constant", base_lr=1e-3),
+            params=params)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        return tree_bytes_per_device(
+            shard_state(state, mesh, zero1=zero1).opt_state)
+
+    rep, z1 = bytes_for(False), bytes_for(True)
+    return {"devices": mesh.shape["data"] * mesh.shape["fsdp"],
+            "replicated_bytes": rep, "zero1_bytes": z1,
+            "ratio": round(z1 / rep, 4) if rep else None}
+
+
 def _lint_status():
     """dltpu-check ratchet verdict for the bench record: a perf number
     from a tree with NEW policy findings (a stray hot-loop sync, a
@@ -345,6 +378,11 @@ def _health_probe():
             cpu_fallback["recovery"] = {"error": repr(e)}
         progress[0] += 1
         try:
+            cpu_fallback["opt_state_bytes_per_device"] = _shard_smoke()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["opt_state_bytes_per_device"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
             cpu_fallback["lint_clean"] = _lint_status()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["lint_clean"] = {"error": repr(e)}
@@ -408,6 +446,12 @@ def main():
                            warmup_steps=100)
     tx = build_optimizer("adamw", sched, weight_decay=0.05, params=params)
     state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    # per-device optimizer-state footprint (ISSUE 10): for this
+    # single-replica bench it equals the global adamw mu/nu bytes; under
+    # shard_state(zero1=True) it drops to ~1/dp — tools/perf_sweep.py
+    # --set shard records that A/B, this field anchors the baseline
+    from deeplearning_tpu.parallel.sharding import tree_bytes_per_device
+    opt_state_bytes = tree_bytes_per_device(state.opt_state)
 
     images = jnp.asarray(
         np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
@@ -454,6 +498,7 @@ def main():
         "step_time_ms": round(dt * 1e3, 2),
         "device": jax.devices()[0].device_kind,
         "batch": batch,
+        "opt_state_bytes_per_device": opt_state_bytes,
     }
     try:
         # serving-path smoke (CPU, a few seconds): rides along so every

@@ -19,6 +19,7 @@ build box.
 from __future__ import annotations
 
 import math
+import re
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -27,8 +28,9 @@ import jax
 __all__ = [
     "iter_eqns", "iter_avals", "peak_intermediate",
     "assert_peak_intermediate_below", "count_primitive",
-    "count_transfers", "count_collectives", "Audit", "builtin_audits",
-    "run_audits",
+    "count_transfers", "count_collectives", "collective_bytes",
+    "hlo_collectives", "count_hlo_collectives", "hlo_collective_bytes",
+    "Audit", "builtin_audits", "run_audits",
 ]
 
 # primitives that move bytes between host and device (or between
@@ -140,6 +142,135 @@ def count_collectives(fn: Callable, *args,
     return out
 
 
+def collective_bytes(fn: Callable, *args,
+                     axis_env: Optional[List[Tuple[str, int]]] = None,
+                     **kwargs) -> Dict[str, int]:
+    """Per-primitive OPERAND bytes of cross-replica collectives in the
+    traced ``fn(*args)`` — what each collective puts on the wire (before
+    any topology-aware lowering), summed per primitive name. The byte
+    companion to ``count_collectives``; same axis_env contract."""
+    mk = jax.make_jaxpr(fn, axis_env=axis_env) if axis_env else \
+        jax.make_jaxpr(fn)
+    closed = mk(*args, **kwargs)
+    out: Dict[str, int] = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        nm = eqn.primitive.name
+        if nm not in COLLECTIVE_PRIMITIVES:
+            continue
+        nbytes = 0
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            dtype = getattr(aval, "dtype", None)
+            if shape is not None and dtype is not None:
+                nbytes += int(math.prod(shape)) * dtype.itemsize
+        out[nm] = out.get(nm, 0) + nbytes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Compiled-HLO collective accounting. GSPMD inserts collectives during
+# SPMD partitioning, AFTER tracing — a jitted step's jaxpr shows none of
+# them, so proving "zero1 lowers the gradient all-reduce to
+# reduce-scatter + all-gather" requires reading the post-optimization
+# HLO. One platform wart is handled here: XLA's ReduceScatterCreator
+# combiner runs on TPU/GPU only, so on CPU the reduce-scatter appears as
+# all-reduce followed by a partition dynamic-slice (full result, then
+# each replica keeps its 1/n). ``hlo_collectives`` reclassifies that
+# pair as ``reduce_scatter`` — it IS the reduce-scatter this program
+# lowers to on TPU — which keeps the audit meaningful on the CPU CI box.
+# ---------------------------------------------------------------------------
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+_HLO_OP_RE = re.compile(
+    r"=\s*(?P<dt>[a-z]+\d*)\[(?P<shape>[\d,]*)\](?:\{[^}]*\})?\s*"
+    r"(?P<op>all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute|dynamic-slice)(?P<suffix>-start|-done)?"
+    r"\(\s*(?:(?P<odt>[a-z]+\d*)\[(?P<oshape>[\d,]*)\])?")
+
+
+def _shape_elems(shape_str: str) -> int:
+    return int(math.prod(int(d) for d in shape_str.split(",") if d))
+
+
+def _hlo_module_text(obj: Any, *args, **kwargs) -> str:
+    """Post-optimization HLO text from a str, a compiled executable
+    (``jit(f).lower(...).compile()``), or a callable + example args
+    (jitted or not — plain callables are wrapped in ``jax.jit``)."""
+    if isinstance(obj, str):
+        return obj
+    if hasattr(obj, "lower"):                      # jitted function
+        return obj.lower(*args, **kwargs).compile().as_text()
+    if hasattr(obj, "compile"):                    # Lowered
+        return obj.compile().as_text()
+    if hasattr(obj, "as_text"):                    # Compiled executable
+        return obj.as_text()
+    return jax.jit(obj).lower(*args, **kwargs).compile().as_text()
+
+
+def hlo_collectives(obj: Any, *args, reclassify_scatter: bool = True,
+                    **kwargs) -> Dict[str, Dict[str, int]]:
+    """Collectives in compiled HLO: ``{op: {count, bytes, max_bytes}}``
+    with underscore op names (``all_reduce``, ``reduce_scatter``, ...);
+    bytes are the op's OUTPUT buffer (sum / max over occurrences).
+    Async ``-start``/``-done`` pairs count once. With
+    ``reclassify_scatter`` (default), an all-reduce whose full result
+    feeds a dynamic-slice producing exactly 1/num_partitions of it is
+    reported as ``reduce_scatter`` (see module comment: XLA:CPU lacks
+    the reduce-scatter combiner pass)."""
+    text = _hlo_module_text(obj, *args, **kwargs)
+    m = re.search(r"num_partitions=(\d+)", text)
+    n_part = int(m.group(1)) if m else 1
+
+    colls: List[Tuple[str, str, str]] = []   # (op, dtype, shape)
+    slices: List[Tuple[str, str]] = []       # (out_shape, operand_shape)
+    for mo in _HLO_OP_RE.finditer(text):
+        if mo.group("suffix") == "-done":
+            continue
+        op = mo.group("op")
+        if op == "dynamic-slice":
+            if mo.group("oshape") is not None:
+                slices.append((mo.group("shape"), mo.group("oshape")))
+            continue
+        colls.append((op, mo.group("dt"), mo.group("shape")))
+
+    def is_scattered(shape: str) -> bool:
+        if n_part <= 1:
+            return False
+        elems = _shape_elems(shape)
+        return any(osh == shape and _shape_elems(sh) * n_part == elems
+                   for sh, osh in slices)
+
+    out: Dict[str, Dict[str, int]] = {}
+    for op, dt, shape in colls:
+        if (reclassify_scatter and op == "all-reduce"
+                and is_scattered(shape)):
+            op = "reduce-scatter"
+        name = op.replace("-", "_")
+        nbytes = _shape_elems(shape) * _HLO_DTYPE_BYTES.get(dt, 4)
+        row = out.setdefault(name, {"count": 0, "bytes": 0, "max_bytes": 0})
+        row["count"] += 1
+        row["bytes"] += nbytes
+        row["max_bytes"] = max(row["max_bytes"], nbytes)
+    return out
+
+
+def count_hlo_collectives(obj: Any, *args, **kwargs) -> Dict[str, int]:
+    """``{op: count}`` view of ``hlo_collectives``."""
+    return {op: row["count"]
+            for op, row in hlo_collectives(obj, *args, **kwargs).items()}
+
+
+def hlo_collective_bytes(obj: Any, *args, **kwargs) -> Dict[str, int]:
+    """``{op: total_bytes}`` view of ``hlo_collectives``."""
+    return {op: row["bytes"]
+            for op, row in hlo_collectives(obj, *args, **kwargs).items()}
+
+
 # --------------------------------------------------------------- audits
 class Audit:
     """One registered structural check for ``tools/check.py --jaxpr``:
@@ -151,6 +282,7 @@ class Audit:
                  max_elements: Optional[int] = None,
                  max_transfers: Optional[int] = 0,
                  min_elements: Optional[int] = None,
+                 extra: Optional[Callable[[], Tuple[bool, Dict]]] = None,
                  note: str = ""):
         self.name = name
         self.fn = fn
@@ -158,6 +290,7 @@ class Audit:
         self.max_elements = max_elements
         self.max_transfers = max_transfers
         self.min_elements = min_elements
+        self.extra = extra
         self.note = note
 
     def run(self) -> Dict[str, Any]:
@@ -174,6 +307,12 @@ class Audit:
                 ok &= row["peak_elements"] >= self.min_elements
             if self.max_transfers is not None:
                 ok &= row["transfers"] <= self.max_transfers
+            if self.extra is not None:
+                # audit-specific measurement (e.g. compiled-HLO
+                # collective checks); its dict merges into the row
+                extra_ok, extra_row = self.extra()
+                row.update(extra_row)
+                ok &= extra_ok
             row["ok"] = bool(ok)
         except Exception as e:  # noqa: BLE001 - a broken audit must report
             row["ok"] = False
@@ -189,7 +328,11 @@ def builtin_audits() -> List[Audit]:
     - the reference NMS row PROVES the auditor sees an N×N blow-up;
     - one-pass RoIAlign does <=8 gathers (one sampling pass);
     - the mnist train step traces with zero transfer primitives (the
-      PR 1 sync-free contract, structural form).
+      PR 1 sync-free contract, structural form);
+    - (>= 2 devices only) the zero1 train step compiles to
+      reduce-scatter + all-gather with no param-sized all-reduce, with
+      the replicated step as the control row that DOES show the
+      all-reduce zero1 replaced.
     """
     import jax.numpy as jnp
 
@@ -248,6 +391,75 @@ def builtin_audits() -> List[Audit]:
                      note="hot-loop step: zero transfer primitives")
 
     audits.append(train_step_audit())
+
+    def zero1_audits() -> List[Audit]:
+        from ..core.registry import MODELS
+        from ..parallel.mesh import MeshConfig, build_mesh
+        from ..train import TrainState, make_train_step
+        from ..train.classification import make_loss_fn
+        from ..train.optim import build_optimizer
+        from ..train.schedules import build_schedule
+        from ..train.steps import shard_state
+
+        mesh = build_mesh(MeshConfig(data=-1))
+        n_dev = mesh.shape["data"] * mesh.shape["fsdp"]
+
+        def fresh(zero1: bool) -> TrainState:
+            model = MODELS.build("mnist_fcn", num_classes=4,
+                                 dtype=jnp.float32)
+            params = model.init(jax.random.key(0),
+                                jnp.zeros((1, 16, 16, 1)))["params"]
+            tx = build_optimizer(
+                "adamw", build_schedule("constant", base_lr=1e-3),
+                params=params)
+            state = TrainState.create(apply_fn=model.apply,
+                                      params=params, tx=tx)
+            return shard_state(state, mesh, zero1=zero1)
+
+        batch = {"image": jnp.zeros((8 * n_dev, 16, 16, 1)),
+                 "label": jnp.zeros((8 * n_dev,), jnp.int32)}
+        rng = jax.random.key(0)
+        out: List[Audit] = []
+
+        for mode in ("zero1", "replicated"):
+            state = fresh(zero1=(mode == "zero1"))
+            step = make_train_step(make_loss_fn(), mesh=mesh,
+                                   donate=False, weight_update=mode)
+            # the biggest param leaf is the threshold for "param-sized":
+            # any all-reduce at or above it means the gradient
+            # all-reduce survived; smaller ones are the non-divisible
+            # tail and scalar metric reductions
+            param_bytes = max(
+                int(math.prod(p.shape)) * p.dtype.itemsize
+                for p in jax.tree.leaves(state.params))
+
+            def extra(step=step, state=state, mode=mode,
+                      param_bytes=param_bytes):
+                hlo = hlo_collectives(step, state, batch, rng)
+                row = {"hlo_collectives":
+                       {op: r["count"] for op, r in hlo.items()},
+                       "collective_bytes":
+                       {op: r["bytes"] for op, r in hlo.items()}}
+                ar_max = hlo.get("all_reduce", {}).get("max_bytes", 0)
+                if mode == "zero1":
+                    ok = (hlo.get("reduce_scatter", {}).get("count", 0) >= 1
+                          and hlo.get("all_gather", {}).get("count", 0) >= 1
+                          and ar_max < param_bytes)
+                else:
+                    ok = ar_max >= param_bytes
+                return ok, row
+
+            out.append(Audit(
+                f"train_step_{mode}_dp{n_dev}", step,
+                (fresh(zero1=(mode == "zero1")), batch, rng),
+                max_transfers=0, extra=extra,
+                note=("grad AR lowered to reduce-scatter + all-gather"
+                      if mode == "zero1" else
+                      "control: full-gradient all-reduce present")))
+        return out
+
+    if len(jax.devices()) >= 2:
+        audits.extend(zero1_audits())
     return audits
 
 

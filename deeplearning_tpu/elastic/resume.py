@@ -32,7 +32,8 @@ __all__ = ["elastic_restore"]
 
 def elastic_restore(ckpt: CheckpointManager, state: Any, mesh: Mesh,
                     rules: Optional[Rules] = None,
-                    step: Optional[int] = None) -> Tuple[Any, int]:
+                    step: Optional[int] = None,
+                    zero1: bool = False) -> Tuple[Any, int]:
     """Restore the newest checkpoint onto ``mesh`` — re-sharding as
     needed — and return ``(state, step)``.
 
@@ -40,8 +41,15 @@ def elastic_restore(ckpt: CheckpointManager, state: Any, mesh: Mesh,
     its values are discarded when a checkpoint exists. With no
     checkpoint, returns the template sharded onto the mesh at step 0 —
     i.e. calling this unconditionally at startup is the whole resume
-    policy."""
-    target = shard_state(state, mesh, rules)
+    policy.
+
+    ``zero1=True`` builds the target with data-sharded optimizer moments
+    (``shard_state(..., zero1=True)``): a ZeRO-1 checkpoint saved on one
+    data-parallel extent restores onto another with the moments bitwise
+    the saved values, just re-split — and a replicated checkpoint can be
+    adopted INTO zero1 the same way (the sidecar's ``weight_update``
+    field says which it was)."""
+    target = shard_state(state, mesh, rules, zero1=zero1)
     # integrity-checked restore: a corrupt newest step is quarantined and
     # the next intact one restored instead (core.checkpoint hardening)
     restored, got = ckpt.restore_verified(target, step)

@@ -38,11 +38,35 @@ def _mesh_from_state(state: Any) -> Optional[Mesh]:
     return None
 
 
+def _infer_weight_update(state: Any) -> Optional[str]:
+    """'zero1' when the state's optimizer moments are sharded while the
+    params are replicated (the ZeRO-1 signature), 'replicated' for a
+    fully-replicated opt state; None when the state has no opt_state or
+    the layout is something else (TP/FSDP shards params too — then the
+    weight-update mode is not inferable from layout alone)."""
+    params = getattr(state, "params", None)
+    opt = getattr(state, "opt_state", None)
+    if params is None or opt is None:
+        return None
+    p = shard_layout_summary(params)
+    o = shard_layout_summary(opt)
+    if p["sharded"] == 0 and o["sharded"] > 0:
+        return "zero1"
+    if p["sharded"] == 0 and o["sharded"] == 0:
+        return "replicated"
+    return None
+
+
 def current_topology(mesh: Optional[Mesh] = None,
-                     state: Optional[Any] = None) -> Dict[str, Any]:
+                     state: Optional[Any] = None,
+                     weight_update: Optional[str] = None) -> Dict[str, Any]:
     """Fingerprint the running process: device/process counts, platform,
     the mesh axis sizes (given a mesh, or inferred from ``state``'s
-    shardings), and the state's shard layout (when given)."""
+    shardings), and the state's shard layout (when given). The
+    weight-update mode rides along in the sidecar — passed explicitly by
+    the Trainer, else inferred from the state's moment/param layouts —
+    so a resume knows the checkpoint's opt state is ZeRO-1-sharded
+    before it rebuilds the target layout."""
     devices = jax.devices()
     doc: Dict[str, Any] = {
         "device_count": len(devices),
@@ -59,6 +83,14 @@ def current_topology(mesh: Optional[Mesh] = None,
             doc["shard_layout"] = shard_layout_summary(state)
         except Exception:  # noqa: BLE001 - a summary failure must not
             pass           # block the checkpoint that embeds it
+    if weight_update is None and state is not None:
+        try:
+            weight_update = _infer_weight_update(state)
+        # dltpu: allow(DLT104) best-effort inference must not block the save
+        except Exception:  # noqa: BLE001
+            pass
+    if weight_update is not None:
+        doc["weight_update"] = weight_update
     return doc
 
 

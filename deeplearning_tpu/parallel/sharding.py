@@ -115,6 +115,90 @@ FSDP_RULES: Rules = (
 )
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 weight-update sharding (PAPERS.md "Automatic Cross-Replica
+# Sharding of Weight Update in Data-Parallel Training"): optimizer-moment
+# leaves shard over the data axes instead of replicating, and the train
+# step's matching sharding constraints let XLA lower the DDP all-reduce
+# into reduce-scatter -> per-shard update -> all-gather.
+# ---------------------------------------------------------------------------
+
+
+def zero1_partition_spec(shape: Tuple[int, ...], dp: int) -> P:
+    """Spec sharding the FIRST dim of ``shape`` divisible by the
+    data-parallel extent ``dp`` over ('data','fsdp'); ``P()`` when no dim
+    divides — the small-leaf tail (biases of odd width, scalars) stays
+    replicated rather than padded, and ``shard_layout_summary`` shows it.
+    First-divisible-dim (not largest) keeps the choice predictable and
+    lets the quantized grad path reduce-scatter along dim 0."""
+    if dp <= 1:
+        return P()
+    for d, size in enumerate(shape):
+        if size >= dp and size % dp == 0:
+            spec = [None] * len(shape)
+            spec[d] = (DATA_AXIS, FSDP_AXIS)
+            return P(*spec)
+    return P()
+
+
+def zero1_shardings(params: Any, mesh: Mesh,
+                    rules: Optional[Rules] = None) -> Any:
+    """NamedSharding pytree for param-SHAPED trees (adam mu/nu, grads
+    mid-update) under ZeRO-1: leaves a TP/FSDP rule already shards keep
+    their rule layout; rule-replicated leaves shard over the full
+    data-parallel extent when a dim divides, else stay replicated."""
+    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    base = shard_params_tree(params, mesh, rules)
+
+    def pick(leaf: Any, sh: NamedSharding) -> NamedSharding:
+        if not sh.is_fully_replicated:
+            return sh
+        return NamedSharding(mesh, zero1_partition_spec(
+            tuple(np.shape(leaf)), dp))
+    return jax.tree.map(pick, params, base)
+
+
+def opt_state_shardings(opt_state: Any, params_treedef: Any,
+                        param_sh: Any, rep: NamedSharding,
+                        on_fallback: Optional[Callable[[Any, Exception],
+                                                       None]] = None) -> Any:
+    """Sharding pytree mirroring an optax state: param-structured
+    subtrees (ScaleByAdam mu/nu and friends) get ``param_sh``, everything
+    else (step counters, un-flattenable fields) ``rep``. Shared by
+    ``shard_state`` (placement) and the zero1 train step (the matching
+    in-step constraints), so the two can never disagree."""
+    def go(opt: Any) -> Any:
+        if hasattr(opt, "_fields"):
+            return type(opt)(*(go(f) for f in opt))
+        if isinstance(opt, (tuple, list)):
+            return type(opt)(go(o) for o in opt)
+        try:
+            if jax.tree.structure(opt) == params_treedef:
+                return param_sh
+        except (TypeError, ValueError) as e:
+            if on_fallback is not None:
+                on_fallback(opt, e)
+        return jax.tree.map(lambda x: rep, opt)
+    return go(opt_state)
+
+
+def tree_bytes_per_device(tree: Any) -> int:
+    """Bytes ONE device holds for a placed pytree — shard sizes, not
+    global sizes. This is the per-device HBM cost ZeRO-1 exists to cut:
+    replicated vs zero1 opt states differ by ~the data-parallel extent."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
 def shard_layout_summary(tree: Any) -> Dict[str, Any]:
     """Compact JSON-able description of how a pytree is laid out: the
     PartitionSpec of every NON-replicated jax.Array leaf (keyed by

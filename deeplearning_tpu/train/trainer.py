@@ -99,6 +99,7 @@ class Trainer:
         prefetch="auto",
         obs="auto",
         run_config: Optional[Dict] = None,
+        weight_update: Optional[str] = None,
         hbm_sample_s: float = 0.25,
         hbm_alert_frac: Optional[float] = None,
         preemptible: bool = True,
@@ -153,6 +154,10 @@ class Trainer:
         # flight ring — recording is bounded and sync-free.
         self.obs_enabled = bool(workdir) if obs == "auto" else bool(obs)
         self.run_config = run_config
+        # weight-update sharding mode ("replicated"/"zero1"), recorded in
+        # every checkpoint's topology sidecar; None lets the sidecar
+        # infer it from the state's moment/param layouts
+        self.weight_update = weight_update
         self.hbm_sample_s = hbm_sample_s
         self._hbm = None
         self._obs_owns_tracer = False
@@ -749,7 +754,8 @@ class Trainer:
         cross-topology resume reports it is re-sharding FROM."""
         try:
             from ..elastic.topology import current_topology
-            return current_topology(state=self.state)
+            return current_topology(state=self.state,
+                                    weight_update=self.weight_update)
         except Exception:  # noqa: BLE001 - never block a save on it
             return None
 

@@ -1,0 +1,349 @@
+"""ZeRO-1 weight-update sharding + quantized collectives (ISSUE 10).
+
+The compiler-driven ZeRO-1 contract: ``shard_state(zero1=True)`` splits
+optimizer moments 1/dp per device, ``make_train_step(weight_update=
+"zero1")`` keeps them there across steps with loss parity against the
+replicated baseline, and the EQuARX-style int8 collectives reduce
+gradients bitwise-exactly on small-integer payloads with a documented
+error bound on general values. The HLO-level proof that the lowering is
+reduce-scatter -> shard-update -> all-gather lives in the jaxpr audits
+(tests/test_analysis.py); here we test semantics and memory."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning_tpu.core.registry import MODELS
+from deeplearning_tpu.parallel import MeshConfig, build_mesh
+from deeplearning_tpu.parallel._compat import shard_map
+from deeplearning_tpu.parallel.collectives import (
+    quantized_psum, quantized_psum_tree, quantized_reduce_scatter)
+from deeplearning_tpu.parallel.sharding import (
+    DATA_AXIS, FSDP_AXIS, P, batch_sharding, shard_layout_summary,
+    tree_bytes_per_device, zero1_partition_spec)
+from deeplearning_tpu.train import TrainState, make_train_step, shard_state
+from deeplearning_tpu.train.classification import make_loss_fn
+
+AXES = (DATA_AXIS, FSDP_AXIS)
+
+needs_devices = pytest.mark.skipif(len(jax.devices()) < 8,
+                                   reason="needs 8 (virtual) devices")
+
+
+def _mnist_state(seed: int = 0, tx=None) -> TrainState:
+    model = MODELS.build("mnist_fcn", num_classes=4, dtype=jnp.float32)
+    params = model.init(jax.random.key(seed),
+                        jnp.zeros((1, 16, 16, 1)))["params"]
+    return TrainState.create(apply_fn=model.apply, params=params,
+                             tx=tx if tx is not None else optax.adamw(1e-3))
+
+
+def _mnist_batch(rng: np.random.Generator, n: int):
+    return {"image": jnp.asarray(rng.normal(size=(n, 16, 16, 1)),
+                                 jnp.float32),
+            "label": jnp.asarray(rng.integers(0, 4, n), jnp.int32)}
+
+
+class TestZero1PartitionSpec:
+    def test_first_divisible_dim_wins(self):
+        assert zero1_partition_spec((16, 24), 8) == P(AXES, None)
+        # dim 0 indivisible, dim 1 divides -> dim 1 carries the shard
+        assert zero1_partition_spec((10, 16), 8) == P(None, AXES)
+
+    def test_indivisible_leaf_replicates(self):
+        assert zero1_partition_spec((10,), 8) == P()
+        assert zero1_partition_spec((4,), 8) == P()      # smaller than dp
+        assert zero1_partition_spec((), 8) == P()
+
+    def test_dp1_is_noop(self):
+        assert zero1_partition_spec((512, 512), 1) == P()
+
+
+@needs_devices
+class TestZero1Memory:
+    def test_opt_bytes_shrink_by_data_extent(self):
+        """The headline claim: per-device optimizer bytes under zero1 are
+        <= 1/dp of replicated, plus only the non-divisible tail that
+        legitimately stays replicated."""
+        mesh = build_mesh(MeshConfig(data=-1))
+        dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+
+        rep = shard_state(_mnist_state(0), mesh, zero1=False)
+        z = shard_state(_mnist_state(0), mesh, zero1=True)
+        rep_bytes = tree_bytes_per_device(rep.opt_state)
+        z_bytes = tree_bytes_per_device(z.opt_state)
+
+        # slack = whatever zero1 left replicated (odd-width biases,
+        # scalar counters) — everything else must be a true 1/dp shard
+        slack = sum(
+            int(np.prod(leaf.shape, dtype=np.int64)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(z.opt_state)
+            if leaf.sharding.is_fully_replicated)
+        assert z_bytes <= rep_bytes // dp + slack
+        # and the shrink is real, not vacuous
+        assert z_bytes < rep_bytes // 2
+
+    def test_non_divisible_tail_stays_replicated_and_visible(self):
+        """mnist_fcn's 4-class head bias (4,) cannot split 8 ways: it
+        must stay replicated and shard_layout_summary must show the
+        mixed layout rather than papering over it."""
+        mesh = build_mesh(MeshConfig(data=-1))
+        z = shard_state(_mnist_state(0), mesh, zero1=True)
+
+        summary = shard_layout_summary(z.opt_state)
+        assert summary["sharded"] > 0
+        assert summary["replicated"] > 0
+        # the (4,) head bias moments are in the replicated bucket...
+        head_bias = [path for path in summary["specs"]
+                     if path.endswith("Dense_2/bias")]
+        assert not head_bias
+        # ...while the matching (256, 4) head kernel moments sharded
+        assert any(path.endswith("Dense_2/kernel")
+                   for path in summary["specs"])
+        # params are untouched by zero1 — pure DP stays fully replicated
+        assert shard_layout_summary(z.params)["sharded"] == 0
+
+
+@needs_devices
+class TestZero1Parity:
+    def test_50_step_loss_parity_and_stable_layout(self):
+        """50 optimizer steps under zero1 track the replicated baseline
+        at float-roundoff level (the math is the same Adam, only
+        sharded), and the moment layout is a fixed point of the step —
+        no per-step reshuffling creeping in."""
+        mesh = build_mesh(MeshConfig(data=-1))
+        loss_fn = make_loss_fn()
+        step_rep = make_train_step(loss_fn, mesh=mesh)
+        step_z = make_train_step(loss_fn, mesh=mesh, weight_update="zero1")
+
+        st_rep = shard_state(_mnist_state(0), mesh, zero1=False)
+        st_z = shard_state(_mnist_state(0), mesh, zero1=True)
+
+        layout0 = None
+        losses_rep, losses_z = [], []
+        g = np.random.default_rng(0)
+        for i in range(50):
+            batch = jax.device_put(_mnist_batch(g, 64),
+                                   batch_sharding(mesh))
+            rng = jax.random.key(i)
+            st_rep, m_rep = step_rep(st_rep, batch, rng)
+            st_z, m_z = step_z(st_z, batch, rng)
+            losses_rep.append(float(m_rep["loss"]))
+            losses_z.append(float(m_z["loss"]))
+            if i == 0:
+                layout0 = shard_layout_summary(st_z.opt_state)
+                bytes0 = tree_bytes_per_device(st_z.opt_state)
+
+        np.testing.assert_allclose(losses_z, losses_rep,
+                                   rtol=1e-5, atol=1e-5)
+        # final params agree leaf-by-leaf at accumulated-roundoff scale
+        # (per-step diff is ~1e-7; 50 Adam steps compound to ~1e-5)
+        for lz, lr in zip(jax.tree.leaves(st_z.params),
+                          jax.tree.leaves(st_rep.params)):
+            np.testing.assert_allclose(np.asarray(lz), np.asarray(lr),
+                                       rtol=1e-3, atol=1e-4)
+        # layout and per-device footprint are step-invariant
+        assert shard_layout_summary(st_z.opt_state) == layout0
+        assert tree_bytes_per_device(st_z.opt_state) == bytes0
+        assert shard_layout_summary(st_z.params)["sharded"] == 0
+
+
+class TestGradDtypePolicy:
+    """The fp32-gradient unification satellite: with bf16 params the
+    optimizer must see fp32 gradients on BOTH the single-step and the
+    accumulation paths (before ISSUE 10 the accum path upcast and the
+    accum_steps=1 path handed optax raw bf16)."""
+
+    @pytest.mark.parametrize("accum_steps", [1, 2])
+    def test_optimizer_sees_fp32_grads(self, accum_steps):
+        seen = set()
+        base = optax.sgd(1e-2)
+
+        def update(grads, opt_state, params=None):
+            seen.update(str(l.dtype) for l in jax.tree.leaves(grads))
+            return base.update(grads, opt_state, params)
+
+        params = {"w": jnp.full((8, 4), 0.5, jnp.bfloat16)}
+        state = TrainState.create(
+            apply_fn=lambda *a, **k: None, params=params,
+            tx=optax.GradientTransformation(base.init, update))
+
+        def loss_fn(params, state, batch, rng):
+            pred = batch["x"].astype(jnp.bfloat16) @ params["w"]
+            loss = jnp.mean((pred.astype(jnp.float32) - batch["y"]) ** 2)
+            return loss, {}
+
+        step = make_train_step(loss_fn, accum_steps=accum_steps,
+                               donate=False)
+        batch = {"x": jnp.ones((4, 8)), "y": jnp.zeros((4, 4))}
+        state, metrics = step(state, batch, jax.random.key(0))
+        assert np.isfinite(float(metrics["loss"]))
+        assert seen == {"float32"}, (
+            f"optimizer saw {seen} grads at accum_steps={accum_steps}")
+
+
+@needs_devices
+class TestQuantizedCollectives:
+    def _mesh(self):
+        return build_mesh(MeshConfig(data=-1))
+
+    def test_psum_bitwise_exact_on_small_ints(self):
+        """Power-of-two block scales shift integer payloads losslessly:
+        on small-int values (and sums) the quantized all-reduce is
+        BITWISE equal to jax.lax.psum."""
+        mesh = self._mesh()
+        n = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+        g = np.random.default_rng(0)
+        vals = jnp.asarray(g.integers(-7, 8, (n, 96)), jnp.float32)
+
+        f = jax.jit(shard_map(
+            lambda x: (quantized_psum(x[0], AXES, block=16),
+                       jax.lax.psum(x[0], AXES)),
+            mesh=mesh, in_specs=(P(AXES),), out_specs=(P(), P()),
+            check_vma=False))
+        q, exact = f(vals)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(exact))
+
+    def test_psum_tree_gaussian_error_bound(self):
+        """General-case accuracy: two quantization stages bound the
+        error at ~2/127 of the block max — assert the documented 5%
+        relative bound with plenty of margin (measured ~1%)."""
+        mesh = self._mesh()
+        n = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+        g = np.random.default_rng(1)
+        tree = {"a": jnp.asarray(g.normal(size=(n, 4096)), jnp.float32),
+                "b": jnp.asarray(g.normal(size=(n, 33, 7)), jnp.float32)}
+
+        f = jax.jit(shard_map(
+            lambda t: (quantized_psum_tree(
+                           jax.tree.map(lambda x: x[0], t), AXES),
+                       jax.tree.map(lambda x: jax.lax.psum(x[0], AXES), t)),
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(AXES), tree),),
+            out_specs=(jax.tree.map(lambda _: P(), tree),) * 2,
+            check_vma=False))
+        q, exact = f(tree)
+        for key in tree:
+            qe = np.asarray(q[key]), np.asarray(exact[key])
+            rel = np.abs(qe[0] - qe[1]).max() / np.abs(qe[1]).max()
+            assert rel < 0.05, f"{key}: rel err {rel:.4f} exceeds bound"
+
+    def test_reduce_scatter_matches_psum_slice(self):
+        """Each replica's reduce-scatter shard is its leading-dim slice
+        of the full sum — gathering the shards reconstructs psum, and
+        the single-stage path is exact on integer payloads."""
+        mesh = self._mesh()
+        n = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+        g = np.random.default_rng(2)
+        vals = jnp.asarray(g.integers(-5, 6, (n, 2 * n, 5)), jnp.float32)
+
+        f = jax.jit(shard_map(
+            lambda x: (quantized_reduce_scatter(x[0], AXES, block=16),
+                       jax.lax.psum(x[0], AXES)),
+            mesh=mesh, in_specs=(P(AXES),),
+            out_specs=(P(AXES), P()), check_vma=False))
+        scattered, full = f(vals)       # shards gather back to (2n, 5)
+        np.testing.assert_array_equal(np.asarray(scattered),
+                                      np.asarray(full))
+
+    def test_reduce_scatter_rejects_indivisible_dim0(self):
+        mesh = self._mesh()
+        n = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+        vals = jnp.ones((n, n + 1, 3), jnp.float32)
+        f = shard_map(
+            lambda x: quantized_reduce_scatter(x[0], AXES),
+            mesh=mesh, in_specs=(P(AXES),), out_specs=P(AXES),
+            check_vma=False)
+        with pytest.raises(ValueError, match="dim0"):
+            jax.jit(f)(vals)
+
+
+@needs_devices
+class TestInt8TrainStep:
+    def test_step_parity_against_fp32_rng_free(self):
+        """One SGD step on an RNG-free linear MSE model: the int8-reduced
+        update differs from the fp32 baseline by at most 5% of the max
+        update magnitude (the per-leaf quantization bound), and the
+        reported loss — which rides an fp32 pmean, never the int8 wire —
+        matches tightly."""
+        mesh = build_mesh(MeshConfig(data=-1))
+
+        def loss_fn(params, state, batch, rng):
+            pred = batch["image"] @ params["w"]
+            return jnp.mean((pred - batch["label"]) ** 2), {}
+
+        def fresh():
+            params = {"w": jnp.zeros((16, 4), jnp.float32)}
+            return shard_state(
+                TrainState.create(apply_fn=lambda *a, **k: None,
+                                  params=params, tx=optax.sgd(0.1)),
+                mesh)
+
+        g = np.random.default_rng(0)
+        batch = {"image": jnp.asarray(g.normal(size=(32, 16)),
+                                      jnp.float32),
+                 "label": jnp.asarray(g.normal(size=(32, 4)),
+                                      jnp.float32)}
+        batch = jax.device_put(batch, batch_sharding(mesh))
+        rng = jax.random.key(0)
+
+        base = fresh()
+        st32, m32 = make_train_step(loss_fn, mesh=mesh,
+                                    donate=False)(fresh(), batch, rng)
+        st8, m8 = make_train_step(loss_fn, mesh=mesh, donate=False,
+                                  grad_comm="int8")(fresh(), batch, rng)
+
+        w32 = np.asarray(st32.params["w"])
+        w8 = np.asarray(st8.params["w"])
+        update_scale = np.abs(w32 - np.asarray(base.params["w"])).max()
+        assert update_scale > 0          # the step actually moved
+        assert np.abs(w8 - w32).max() <= 0.05 * update_scale
+        np.testing.assert_allclose(float(m8["loss"]), float(m32["loss"]),
+                                   rtol=1e-5)
+
+    def test_zero1_int8_mnist_smoke(self):
+        """The combined mode — moment-sharded update fed by int8
+        reduce-scatter gradients — trains mnist_fcn to finite decreasing
+        loss with the moment layout intact."""
+        mesh = build_mesh(MeshConfig(data=-1))
+        state = shard_state(_mnist_state(0), mesh, zero1=True)
+        step = make_train_step(make_loss_fn(), mesh=mesh,
+                               weight_update="zero1", grad_comm="int8")
+        g = np.random.default_rng(0)
+        batch = jax.device_put(_mnist_batch(g, 64), batch_sharding(mesh))
+        losses = []
+        for i in range(10):
+            state, metrics = step(state, batch, jax.random.key(i))
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]    # same batch: loss must drop
+        assert shard_layout_summary(state.opt_state)["sharded"] > 0
+
+
+class TestMakeTrainStepValidation:
+    def test_zero1_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            make_train_step(make_loss_fn(), weight_update="zero1")
+
+    def test_int8_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            make_train_step(make_loss_fn(), grad_comm="int8")
+
+    @needs_devices
+    def test_int8_rejects_accum_and_rules(self):
+        mesh = build_mesh(MeshConfig(data=-1))
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_train_step(make_loss_fn(), mesh=mesh,
+                            grad_comm="int8", accum_steps=4)
+        from deeplearning_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+        with pytest.raises(ValueError, match="data-parallel only"):
+            make_train_step(make_loss_fn(), mesh=mesh,
+                            grad_comm="int8", rules=TRANSFORMER_TP_RULES)
+
+    def test_unknown_modes_rejected(self):
+        with pytest.raises(ValueError, match="weight_update"):
+            make_train_step(make_loss_fn(), weight_update="zero3")
+        with pytest.raises(ValueError, match="grad_comm"):
+            make_train_step(make_loss_fn(), grad_comm="fp8")

@@ -152,6 +152,10 @@ def summarize(run_dir: str) -> Dict[str, Any]:
     if recovery:
         out["recovery"] = recovery
 
+    sharding = sharding_summary(flight)
+    if sharding:
+        out["sharding"] = sharding
+
     rows = load_metrics(run_dir)
     if rows:
         steps = [r for r in rows if not r.get("summary")]
@@ -265,6 +269,31 @@ def recovery_summary(child_flight: Optional[Dict[str, Any]]
     return None if empty else out
 
 
+def sharding_summary(child_flight: Optional[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """Sharding posture: the run's weight-update mode (replicated /
+    zero1), gradient-comm dtype, and — when the run recorded its AOT
+    step — the collective bytes one step moves. Read from the last
+    ``sharding`` flight event (tools/train.py records one after
+    precompile) with the flight config as fallback. None when the run
+    predates the knobs."""
+    if child_flight is None:
+        return None
+    out: Dict[str, Any] = {}
+    cfg = child_flight.get("config") or {}
+    train_cfg = cfg.get("train") or {}
+    for key in ("weight_update", "grad_comm"):
+        if train_cfg.get(key) is not None:
+            out[key] = train_cfg[key]
+    for e in child_flight.get("events", []):
+        if e.get("kind") != "sharding":
+            continue
+        for key in ("weight_update", "grad_comm", "collective_bytes"):
+            if e.get(key) is not None:
+                out[key] = e[key]
+    return out or None
+
+
 def render(summary: Dict[str, Any]) -> str:
     lines = [f"run: {summary['run_dir']}",
              f"wall: {summary['wall_ms']:.1f} ms   "
@@ -334,6 +363,14 @@ def render(summary: Dict[str, Any]) -> str:
             + (f" ckpt_fallbacks={rec['ckpt_fallbacks']}"
                if rec["ckpt_fallbacks"] else "")
             + (" EXHAUSTED" if rec.get("exhausted") else ""))
+    sh = summary.get("sharding")
+    if sh:
+        lines.append("")
+        line = (f"sharding: weight_update={sh.get('weight_update', '?')} "
+                f"grad_comm={sh.get('grad_comm', '?')}")
+        if sh.get("collective_bytes") is not None:
+            line += f" collective_bytes/step={sh['collective_bytes']}"
+        lines.append(line)
     m = summary.get("metrics")
     if m:
         lines.append("")
@@ -388,8 +425,13 @@ def _check() -> int:
         rec.record("ckpt_retry", step=2, attempt=1,
                    error="OSError(28, 'No space left')")
         rec.record("ckpt_fallback", from_step=2, to_step=1)
+        # sharding posture event (tools/train.py records it post-compile)
+        rec.record("sharding", weight_update="zero1", grad_comm="int8",
+                   collective_bytes=1252352)
         rec.configure(os.path.join(run_dir, "flightrec.json"),
-                      {"model": "mnist_fcn", "batch": 64})
+                      {"model": "mnist_fcn", "batch": 64,
+                       "train": {"weight_update": "zero1",
+                                 "grad_comm": "int8"}})
         assert rec.dump("divergence",
                         exception=FloatingPointError("loss=nan"))
 
@@ -442,9 +484,14 @@ def _check() -> int:
         assert rc["ckpt_retries"] == 1, rc
         assert rc["ckpt_fallbacks"] == [[2, 1]], rc
         assert not rc["exhausted"], rc
+        sh = summary["sharding"]
+        assert sh["weight_update"] == "zero1", sh
+        assert sh["grad_comm"] == "int8", sh
+        assert sh["collective_bytes"] == 1252352, sh
         for token in ("data_wait", "train_step", "divergence",
                       "restarts:", "cross-topology", "recovery:",
-                      "quarantined=1"):
+                      "quarantined=1", "sharding: weight_update=zero1",
+                      "collective_bytes/step=1252352"):
             assert token in report, report
         # dltpu-check posture line: rules enabled + committed baseline
         ana = summary["analysis"]

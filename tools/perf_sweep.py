@@ -438,11 +438,93 @@ def time_obs_set(results_path=None):
     return res
 
 
+def time_shard_set(results_path=None):
+    """Weight-update sharding A/B (ISSUE 10 tentpole): the same train
+    step timed replicated vs zero1 vs zero1+int8 on the full device
+    mesh. Each row carries step time, per-device optimizer-state bytes
+    (the HBM win ZeRO-1 buys — ~1/dp of replicated), compiled-HLO
+    collective bytes, and the compiler's ``memory_analysis`` argument
+    bytes when available. On TPU this runs ViT-B/16; on CPU the mnist
+    model keeps the sweep inside the tier-1 window."""
+    from bench_util import append_op_result
+
+    from deeplearning_tpu.analysis.jaxpr import hlo_collective_bytes
+    from deeplearning_tpu.core.registry import MODELS
+    from deeplearning_tpu.parallel.mesh import MeshConfig, build_mesh
+    from deeplearning_tpu.parallel.sharding import tree_bytes_per_device
+    from deeplearning_tpu.train import TrainState, make_train_step
+    from deeplearning_tpu.train.classification import make_loss_fn
+    from deeplearning_tpu.train.optim import build_optimizer
+    from deeplearning_tpu.train.schedules import build_schedule
+    from deeplearning_tpu.train.steps import shard_state
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_name, size, chans, per_dev = (
+        ("vit_base_patch16_224", 224, 3, 16) if on_tpu
+        else ("mnist_fcn", 28, 1, 8))
+    mesh = build_mesh(MeshConfig(data=-1))
+    n_dev = mesh.shape["data"] * mesh.shape["fsdp"]
+    batch = per_dev * n_dev
+    model = MODELS.build(model_name, num_classes=1000 if on_tpu else 10)
+    rng = jax.random.key(0)
+    init_params = model.init(rng, jnp.zeros((1, size, size, chans)),
+                             train=False)["params"]
+    gen = np.random.default_rng(0)
+    data = {"image": jnp.asarray(gen.normal(
+                size=(batch, size, size, chans)), jnp.float32),
+            "label": jnp.asarray(gen.integers(
+                0, 1000 if on_tpu else 10, batch), jnp.int32)}
+
+    variants = (("replicated", "replicated", "fp32"),
+                ("zero1", "zero1", "fp32"),
+                ("zero1_int8", "zero1", "int8"))
+    out = {}
+    for name, wu, comm in variants:
+        tx = build_optimizer("adamw",
+                             build_schedule("constant", base_lr=1e-3),
+                             params=init_params)
+        state = TrainState.create(apply_fn=model.apply,
+                                  params=init_params, tx=tx)
+        state = shard_state(state, mesh, zero1=(wu == "zero1"))
+        opt_bytes = tree_bytes_per_device(state.opt_state)
+        step = make_train_step(make_loss_fn(), mesh=mesh, donate=False,
+                               weight_update=wu, grad_comm=comm)
+        compiled = step.lower(state, data, rng).compile()
+        coll = sum(hlo_collective_bytes(compiled).values())
+        arg_bytes = None
+        try:
+            ma = compiled.memory_analysis()
+            arg_bytes = int(getattr(ma, "argument_size_in_bytes", 0))
+        # dltpu: allow(DLT104) memory_analysis is a backend-optional surface
+        except Exception:  # noqa: BLE001
+            pass
+        state, metrics = compiled(state, data, rng)   # warmup
+        float(metrics["loss"])
+        n = 20 if on_tpu else 30
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = compiled(state, data, rng)
+        float(metrics["loss"])
+        ms = (time.perf_counter() - t0) / n * 1e3
+        print(f"shard_{name:<11s} {model_name} {ms:9.3f} ms/step "
+              f"opt_bytes/dev={opt_bytes} collective_bytes={coll}",
+              flush=True)
+        if results_path:
+            append_op_result(results_path, f"shard_{name}", n=batch,
+                             ms=ms, model=model_name, devices=n_dev,
+                             opt_state_bytes_per_device=opt_bytes,
+                             collective_bytes=coll,
+                             argument_bytes=arg_bytes)
+        out[name] = {"ms": ms, "opt_bytes": opt_bytes,
+                     "collective_bytes": coll}
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
                     choices=["batch", "attn", "all", "r5", "decomp",
-                             "feed", "detect", "serve", "obs"])
+                             "feed", "detect", "serve", "obs", "shard"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -474,6 +556,8 @@ def main():
         time_serve_set(results_path=results)
     if args.set == "obs":
         time_obs_set(results_path=results)
+    if args.set == "shard":
+        time_shard_set(results_path=results)
     if args.set == "feed":
         # feed-side A/B for the MFU claim: serial blocking H2D vs the
         # threaded prefetch pipeline, same step, real per-iter batches

@@ -89,6 +89,10 @@ class TrainCfg:
                                      # rollback: anchor + skip + cooldown
     strict: str = ""                 # ""|transfers|nans|all: arm JAX
                                      # sanitizers (see analysis.strict)
+    weight_update: str = "replicated"  # replicated | zero1: shard adam
+                                     # moments over the data axes (ZeRO-1)
+    grad_comm: str = "fp32"          # fp32 | int8: EQuARX block-scaled
+                                     # int8 gradient collectives
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +146,23 @@ def main(argv=None) -> int:
                           or cfg.train.accum_steps > 1):
         raise ValueError("pipeline_stages does not compose with "
                          "mixup/ema/accum_steps yet")
+    if cfg.train.weight_update not in ("replicated", "zero1"):
+        raise ValueError(f"train.weight_update="
+                         f"{cfg.train.weight_update!r} (replicated | zero1)")
+    if cfg.train.grad_comm not in ("fp32", "int8"):
+        raise ValueError(f"train.grad_comm={cfg.train.grad_comm!r} "
+                         "(fp32 | int8)")
+    zero1 = cfg.train.weight_update == "zero1"
+    if (zero1 or cfg.train.grad_comm == "int8") and (
+            pp_stages > 1 or cfg.train.mesh_model_axis > 1
+            or cfg.train.mesh_seq_axis > 1):
+        raise ValueError("train.weight_update=zero1 / train.grad_comm=int8 "
+                         "are data-parallel modes; unset pipeline_stages/"
+                         "mesh_model_axis/mesh_seq_axis")
+    if cfg.train.grad_comm == "int8" and cfg.train.accum_steps > 1:
+        raise ValueError("train.grad_comm=int8 requires "
+                         "train.accum_steps=1 (quantizing microbatch "
+                         "partial sums would stack quantization error)")
     mesh = build_mesh(MeshConfig(
         data=-1,
         model=pp_stages if pp_stages > 1 else cfg.train.mesh_model_axis,
@@ -244,7 +265,7 @@ def main(argv=None) -> int:
             shard_pipeline_state
         state = shard_pipeline_state(state, mesh)
     else:
-        state = shard_state(state, mesh)
+        state = shard_state(state, mesh, zero1=zero1)
     has_bn = bool(variables.get("batch_stats"))
     if not cfg.data.folder:
         def _cls_source(imgs, labs):
@@ -299,7 +320,9 @@ def main(argv=None) -> int:
         base_step = make_train_step(
             make_loss_fn(cfg.train.label_smoothing, has_bn), mesh=mesh,
             accum_steps=cfg.train.accum_steps,
-            donate_batch=cfg.train.donate_batch)
+            donate_batch=cfg.train.donate_batch,
+            weight_update=cfg.train.weight_update,
+            grad_comm=cfg.train.grad_comm)
     if cfg.train.mixup:
         from deeplearning_tpu.core import rng as rng_mod
         from deeplearning_tpu.data.mixup import mixup_cutmix
@@ -333,6 +356,7 @@ def main(argv=None) -> int:
         recovery=(None if cfg.train.recovery in ("none", "")
                   else cfg.train.recovery),
         strict=cfg.train.strict or None,
+        weight_update=cfg.train.weight_update,
         # full config into the flight recorder: a flightrec.json from a
         # crashed run identifies the exact run that produced it
         run_config=dataclasses.asdict(cfg))
@@ -344,6 +368,22 @@ def main(argv=None) -> int:
             trainer.precompile()
         except Exception as e:  # noqa: BLE001 - warmup is best-effort
             print(f"precompile skipped: {e}")
+    # sharding posture into the flight ring (obs_report renders it):
+    # which weight-update mode this run uses and — when the AOT step is
+    # available — how many collective bytes one step moves
+    try:
+        from deeplearning_tpu.obs import flight
+        posture = {"weight_update": cfg.train.weight_update,
+                   "grad_comm": cfg.train.grad_comm}
+        aot = getattr(trainer, "_aot_step", None)
+        if aot is not None:
+            from deeplearning_tpu.analysis.jaxpr import hlo_collective_bytes
+            posture["collective_bytes"] = sum(
+                hlo_collective_bytes(aot).values())
+        flight.record("sharding", **posture)
+    # dltpu: allow(DLT104) posture is observability only, never fail a run
+    except Exception:  # noqa: BLE001
+        pass
     from deeplearning_tpu.elastic import EXIT_PREEMPTED, Preempted
     try:
         trainer.train()
