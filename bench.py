@@ -229,6 +229,51 @@ def _obs_smoke():
     return res
 
 
+def _metrics_smoke():
+    """Metrics-exposition overhead smoke on the host CPU: the same
+    jitted train step with the obs metrics registry off vs on, each
+    instrumented step paying one counter inc + one histogram observe.
+    The fleet telemetry plane rides under the same <2% budget the span
+    tracer answers to — this keeps the two A/Bs side by side in every
+    bench record."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from bench_util import metrics_overhead
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        from deeplearning_tpu.core.registry import MODELS
+        from deeplearning_tpu.train import TrainState, make_train_step
+        from deeplearning_tpu.train.classification import make_loss_fn
+        from deeplearning_tpu.train.optim import build_optimizer
+        from deeplearning_tpu.train.schedules import build_schedule
+
+        model = MODELS.build("mnist_fcn", num_classes=10)
+        rng = jax.random.key(0)
+        params = model.init(rng, jnp.zeros((1, 28, 28, 1)),
+                            train=False)["params"]
+        tx = build_optimizer(
+            "sgd", build_schedule("constant", base_lr=1e-2), params=params)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        data = {
+            "image": jnp.asarray(np.random.default_rng(0).normal(
+                size=(64, 28, 28, 1)), jnp.float32),
+            "label": jnp.asarray(np.random.default_rng(1).integers(
+                0, 10, 64), jnp.int32),
+        }
+        step = jax.jit(make_train_step(make_loss_fn()))
+
+        def one_step(s, b, r):
+            _, m = step(s, b, r)
+            return m["loss"]
+
+        res = metrics_overhead(one_step, (state, data, rng), n=50, reps=3)
+    res["backend"] = "cpu"
+    return res
+
+
 def _recovery_smoke():
     """Self-healing idle-cost smoke on the host CPU: the same jitted
     train step timed bare vs with the Trainer's per-step recovery hooks
@@ -373,6 +418,11 @@ def _health_probe():
             cpu_fallback["obs"] = {"error": repr(e)}
         progress[0] += 1
         try:
+            cpu_fallback["metrics"] = _metrics_smoke()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["metrics"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
             cpu_fallback["recovery"] = _recovery_smoke()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["recovery"] = {"error": repr(e)}
@@ -512,6 +562,12 @@ def main():
         rec["obs"] = _obs_smoke()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["obs"] = {"error": repr(e)}
+    try:
+        # metrics-exposition smoke: registry on vs off rides under the
+        # same <2% budget as the span tracer
+        rec["metrics"] = _metrics_smoke()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["metrics"] = {"error": repr(e)}
     try:
         # self-healing idle-cost smoke: recovery hooks on vs off must
         # stay within the README policy budget (<2%)

@@ -71,6 +71,10 @@ HOT_PATH_MODULES: Tuple[str, ...] = (
     "deeplearning_tpu/data/device_prefetch.py",
     "deeplearning_tpu/serve/batcher.py",
     "deeplearning_tpu/serve/engine.py",
+    # fleet telemetry plane: instrumented hot paths call into these, so
+    # they must be provably sync-free too (stdlib-only by construction)
+    "deeplearning_tpu/obs/metrics.py",
+    "deeplearning_tpu/obs/fleet.py",
 )
 
 # scan roots for lint_tree, relative to the repo root (tests/ is out by

@@ -94,8 +94,9 @@ class QuarantineLog:
                     f.write(json.dumps(entry) + "\n")
             except OSError:
                 pass               # losing a manifest line beats dying
-        from ..obs import flight   # lazy: flight never raises
+        from ..obs import flight, metrics   # lazy: flight never raises
         flight.record("quarantine", **entry)
+        metrics.inc("dltpu_quarantine_total")
         self.check_escalation()
 
     def check_escalation(self) -> None:

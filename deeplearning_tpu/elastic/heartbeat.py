@@ -22,10 +22,28 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-__all__ = ["Heartbeat", "HeartbeatWriter", "read_heartbeat", "ENV_VAR"]
+__all__ = ["Heartbeat", "HeartbeatWriter", "read_heartbeat", "ENV_VAR",
+           "RUN_ID_VAR", "REPLICA_VAR"]
 
 # the supervisor hands its child the heartbeat path through this env var
 ENV_VAR = "DLTPU_HEARTBEAT"
+
+# fleet identity (tools/supervise.py exports these; obs/metrics.py uses
+# the same names) — stamped into every heartbeat doc so supervisor
+# heartbeats and fleet /metrics scrapes join on the same key
+RUN_ID_VAR = "DLTPU_RUN_ID"
+REPLICA_VAR = "DLTPU_REPLICA"
+
+
+def _identity() -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    run_id = os.environ.get(RUN_ID_VAR)
+    replica = os.environ.get(REPLICA_VAR)
+    if run_id:
+        out["run_id"] = run_id
+    if replica is not None and replica != "":
+        out["replica"] = replica
+    return out
 
 
 class Heartbeat:
@@ -67,7 +85,7 @@ class HeartbeatWriter:
     def _write(self) -> None:
         doc = {"time": time.time(), "pid": os.getpid(),
                "step": self.beat.step, "activity": self.beat.activity,
-               "phase": self.beat.phase}
+               "phase": self.beat.phase, **_identity()}
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)

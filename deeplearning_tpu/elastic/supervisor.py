@@ -64,11 +64,17 @@ class SupervisorConfig:
                  poll_s: float = 0.25,
                  kill_grace_s: float = 10.0,
                  env: Optional[Dict[str, str]] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 run_id: Optional[str] = None,
+                 replica: Optional[int] = None):
         self.argv = list(argv)
         self.workdir = os.path.abspath(workdir)
         self.heartbeat_path = os.path.abspath(
             heartbeat_path or os.path.join(self.workdir, "heartbeat.json"))
+        # fleet identity: handed to the child via env so its heartbeat,
+        # /metrics exposition, and trace dump all join on the same key
+        self.run_id = run_id
+        self.replica = replica
         self.max_restarts = int(max_restarts)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_factor = float(backoff_factor)
@@ -205,6 +211,13 @@ class Supervisor:
         env.update(self.cfg.env)
         env[heartbeat.ENV_VAR] = self.cfg.heartbeat_path
         env[faults.ATTEMPT_VAR] = str(attempt)
+        if self.cfg.run_id:
+            env[heartbeat.RUN_ID_VAR] = self.cfg.run_id
+        if self.cfg.replica is not None:
+            env[heartbeat.REPLICA_VAR] = str(self.cfg.replica)
+            # where the child advertises its scrape URL (fleet discovery)
+            env["DLTPU_ENDPOINT_FILE"] = os.path.join(
+                self.cfg.workdir, "endpoint.json")
         return env
 
     def _launch(self, attempt: int) -> subprocess.Popen:

@@ -15,17 +15,30 @@ Three pieces, one policy (README "Observability policy"):
   snapshots, feed stats, retraces, compiles, serve rejections) dumped
   to ``flightrec.json`` on divergence abort, uncaught trainer
   exception, or SIGTERM.
+- ``metrics`` — sync-free Counter/Gauge/Histogram registry with
+  Prometheus text exposition (``/metrics`` on every replica via
+  ``MetricsServer``) and a JSON snapshot. Same disabled-path budget
+  as spans: the hot-path helpers are one ``is None`` check when off.
+- ``fleet``  — scraper/aggregator over N replica ``/metrics``
+  endpoints: rollups (summed QPS, max e2e p99, queue depth, replica
+  status counts), SLO breach flight events, ``fleet.jsonl``
+  timeseries. Pure stdlib; imported lazily (``from .obs import
+  fleet``) since only supervisors need it.
 
 ``tools/obs_report.py`` renders a run directory (metrics.jsonl +
-trace.json + flightrec.json) into the phase-time report every ROADMAP
-on-chip calibration item consumes.
+trace.json + flightrec.json + fleet.jsonl) into the phase-time report
+every ROADMAP on-chip calibration item consumes;
+``tools/trace_merge.py`` joins per-replica trace.json dumps into one
+fleet timeline.
 """
 
-from . import flight, spans, xla
+from . import flight, metrics, spans, xla
 from .flight import FlightRecorder
+from .metrics import MetricsRegistry, MetricsServer
 from .spans import SpanTracer, span, step_span, traced
 from .xla import HbmWatermark, hbm_snapshot, tracked_compile
 
-__all__ = ["spans", "xla", "flight", "SpanTracer", "span", "step_span",
-           "traced", "FlightRecorder", "HbmWatermark", "hbm_snapshot",
-           "tracked_compile"]
+__all__ = ["spans", "xla", "flight", "metrics", "SpanTracer", "span",
+           "step_span", "traced", "FlightRecorder", "HbmWatermark",
+           "hbm_snapshot", "tracked_compile", "MetricsRegistry",
+           "MetricsServer"]

@@ -1,0 +1,370 @@
+"""Fleet scraper/aggregator: N replica /metrics endpoints → one rollup.
+
+The aggregation layer the ROADMAP fleet-controller item consumes: a
+:class:`FleetScraper` polls every replica's ``/metrics`` (the uniform
+schema ``obs/metrics.py`` exposes from both serve and train processes)
+plus ``/healthz``, and :func:`compute_rollup` folds the per-replica
+samples into the controller's decision signals — summed QPS, max/mean
+e2e p99, total queue depth, replicas ready/warming/wedged — while an
+:class:`SLOPolicy` turns budget violations (p99 over budget, error-rate
+burn) into ``slo_breach`` flight events, the exact triggers a future
+autoscaler keys on. Every poll appends one JSON line to
+``fleet.jsonl``, the timeseries ``tools/obs_report.py --fleet`` renders.
+
+Replica discovery: ``tools/supervise.py`` exports
+``DLTPU_ENDPOINT_FILE`` per replica; each replica advertises its URL
+there (``metrics.write_endpoint``), and :func:`discover_endpoints`
+reads the set back from the supervisor workdir — no service registry
+needed for a single-host fleet.
+
+The module is stdlib-only (urllib against loopback replicas, json, no
+jax/numpy — it is DLT100 hot-path covered) and standalone-loadable:
+``tools/obs_report.py --check`` exercises the parser and rollup without
+importing the package. Flight recording degrades to a no-op there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SLOPolicy", "FleetScraper", "parse_prometheus_text",
+    "scrape_replica", "compute_rollup", "discover_endpoints",
+    "FLEET_FILE",
+]
+
+FLEET_FILE = "fleet.jsonl"
+
+# one exposition line: name{labels} value [timestamp]
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:\\.|[^"\\])*)"')
+
+# the /metrics schema contract (README "Observability policy"): the
+# serve adapter in tools/serve.py publishes these names; the rollup
+# below consumes them. Train replicas expose dltpu_train_* instead and
+# simply contribute zeros to the serve sums.
+_QPS = "dltpu_serve_requests_per_s"
+_REJECTS_PER_S = "dltpu_serve_rejects_per_s"
+_E2E_P99 = "dltpu_serve_e2e_ms_p99"
+_QUEUE_DEPTH = "dltpu_serve_queue_depth"
+_REQUESTS_TOTAL = "dltpu_serve_requests_total"
+_REJECTED_TOTAL = "dltpu_serve_rejected_total"
+_TIMED_OUT_TOTAL = "dltpu_serve_timed_out_total"
+_COMPLETED_TOTAL = "dltpu_serve_completed_total"
+
+
+def _unescape(v: str) -> str:
+    return v.replace(r"\"", '"').replace(r"\n", "\n").replace("\\\\", "\\")
+
+
+def parse_prometheus_text(text: str
+                          ) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse text exposition format 0.0.4 into (name, labels, value)
+    samples. Strict on purpose — this parser IS the line-format
+    conformance check the acceptance test runs against our own
+    exposition; a malformed line raises ``ValueError``."""
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not (line.startswith("# HELP ")
+                    or line.startswith("# TYPE ")):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            if line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) < 4 or parts[3] not in (
+                        "counter", "gauge", "histogram", "summary",
+                        "untyped"):
+                    raise ValueError(f"line {lineno}: bad TYPE {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(raw):
+                labels[lm.group("key")] = _unescape(lm.group("val"))
+                consumed = lm.end()
+            # everything between label pairs must be separators only
+            leftover = re.sub(_LABEL_RE, "", raw).replace(",", "").strip()
+            if leftover or (raw and not consumed):
+                raise ValueError(f"line {lineno}: bad labels {raw!r}")
+        val = m.group("value")
+        if val == "+Inf":
+            value = float("inf")
+        elif val == "-Inf":
+            value = float("-inf")
+        else:
+            try:
+                value = float(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"line {lineno}: bad value {val!r}") from e
+        samples.append((m.group("name"), labels, value))
+    return samples
+
+
+def _flat(samples: List[Tuple[str, Dict[str, str], float]]
+          ) -> Dict[str, float]:
+    """Unlabeled samples as one name→value dict (labeled samples keep
+    their raw shape in the caller; the rollup only sums scalars)."""
+    return {name: value for name, labels, value in samples if not labels}
+
+
+def _http_json(url: str, timeout_s: float) -> Tuple[int, Any]:
+    req = urllib.request.Request(url, headers={"Accept": "*/*"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # health endpoints answer 503 with a JSON body — read it
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:  # noqa: BLE001 - body optional on errors
+            return e.code, {}
+
+
+def scrape_replica(url: str, timeout_s: float = 2.0) -> Dict[str, Any]:
+    """One replica's sample: parsed /metrics + /healthz verdict.
+    Unreachable or malformed replicas report ``ok=False`` with the error
+    — the rollup counts them, it never dies on them."""
+    base = url.rstrip("/")
+    out: Dict[str, Any] = {"url": base, "time": time.time()}
+    try:
+        req = urllib.request.Request(base + "/metrics")
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            text = resp.read().decode()
+        samples = parse_prometheus_text(text)
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        out.update(ok=False, status="unreachable", error=repr(e))
+        return out
+    out["ok"] = True
+    out["metrics"] = _flat(samples)
+    for name, labels, _ in samples:
+        if name == "dltpu_replica_info":
+            out.update({k: v for k, v in labels.items()
+                        if k in ("run_id", "replica")})
+    try:
+        code, payload = _http_json(base + "/healthz", timeout_s)
+        out["status"] = str(payload.get("status")
+                            or ("ready" if code == 200 else "degraded"))
+        out["healthz_code"] = code
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        # metrics answered but health didn't: count it degraded
+        out["status"] = "degraded"
+        out["healthz_error"] = repr(e)
+    return out
+
+
+def compute_rollup(samples: Sequence[Dict[str, Any]],
+                   slo: Optional["SLOPolicy"] = None) -> Dict[str, Any]:
+    """Fold per-replica samples into the fleet decision signals. Pure —
+    no I/O — so tests and ``obs_report --check`` drive it directly."""
+    statuses: Dict[str, int] = {}
+    p99s: List[float] = []
+    qps_total = rejects_per_s = queue_depth = 0.0
+    requests_total = rejected_total = timed_out_total = 0.0
+    completed_total = 0.0
+    for s in samples:
+        statuses[s.get("status", "unreachable")] = \
+            statuses.get(s.get("status", "unreachable"), 0) + 1
+        m = s.get("metrics") or {}
+        qps_total += m.get(_QPS, 0.0)
+        rejects_per_s += m.get(_REJECTS_PER_S, 0.0)
+        queue_depth += m.get(_QUEUE_DEPTH, 0.0)
+        requests_total += m.get(_REQUESTS_TOTAL, 0.0)
+        rejected_total += m.get(_REJECTED_TOTAL, 0.0)
+        timed_out_total += m.get(_TIMED_OUT_TOTAL, 0.0)
+        completed_total += m.get(_COMPLETED_TOTAL, 0.0)
+        if _E2E_P99 in m:
+            p99s.append(m[_E2E_P99])
+    errors = rejected_total + timed_out_total
+    error_rate = errors / max(requests_total + rejected_total, 1.0)
+    rollup: Dict[str, Any] = {
+        "time": time.time(),
+        "replicas": len(samples),
+        "replica_status": statuses,
+        "qps_total": round(qps_total, 3),
+        "rejects_per_s_total": round(rejects_per_s, 3),
+        "e2e_ms_p99_max": round(max(p99s), 3) if p99s else 0.0,
+        "e2e_ms_p99_mean": round(sum(p99s) / len(p99s), 3)
+        if p99s else 0.0,
+        "queue_depth_total": round(queue_depth, 1),
+        "requests_total": requests_total,
+        "completed_total": completed_total,
+        "rejected_total": rejected_total,
+        "timed_out_total": timed_out_total,
+        "error_rate": round(error_rate, 5),
+    }
+    if slo is not None:
+        rollup["slo"] = slo.evaluate(rollup)
+    return rollup
+
+
+class SLOPolicy:
+    """Fleet SLO: an e2e p99 budget and an error-rate budget (rejected +
+    timed-out over submitted). ``evaluate`` stamps the verdict into the
+    rollup; the scraper records each breach as a flight event — the
+    trigger stream a fleet controller will consume."""
+
+    def __init__(self, p99_budget_ms: float = 500.0,
+                 error_rate_budget: float = 0.01):
+        self.p99_budget_ms = float(p99_budget_ms)
+        self.error_rate_budget = float(error_rate_budget)
+
+    def evaluate(self, rollup: Dict[str, Any]) -> Dict[str, Any]:
+        p99 = rollup.get("e2e_ms_p99_max", 0.0)
+        err = rollup.get("error_rate", 0.0)
+        p99_breach = p99 > self.p99_budget_ms
+        error_breach = err > self.error_rate_budget
+        return {
+            "p99_budget_ms": self.p99_budget_ms,
+            "error_rate_budget": self.error_rate_budget,
+            "p99_ms": p99,
+            "error_rate": err,
+            "p99_breach": p99_breach,
+            "error_breach": error_breach,
+            "breach": p99_breach or error_breach,
+        }
+
+
+def _flight_record(kind: str, **data: Any) -> None:
+    """Best-effort flight event; a no-op when this module is loaded
+    standalone (obs_report --check has no package context)."""
+    try:
+        from .flight import record
+    except ImportError:
+        return
+    record(kind, **data)
+
+
+def discover_endpoints(run_dir: str) -> List[str]:
+    """Replica URLs advertised under a supervisor workdir: reads
+    ``endpoint.json`` in the dir itself and in each ``replica-*/``
+    child dir, ordered by replica id then path."""
+    candidates = [os.path.join(run_dir, "endpoint.json")]
+    try:
+        entries = sorted(os.listdir(run_dir))
+    except OSError:
+        entries = []
+    for name in entries:
+        p = os.path.join(run_dir, name, "endpoint.json")
+        if os.path.isdir(os.path.join(run_dir, name)):
+            candidates.append(p)
+    found: List[Tuple[int, str]] = []
+    for path in candidates:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        url = doc.get("url") if isinstance(doc, dict) else None
+        if not url:
+            continue
+        try:
+            order = int(doc.get("replica", len(found)))
+        except (TypeError, ValueError):
+            order = len(found)
+        found.append((order, url))
+    return [url for _, url in sorted(found)]
+
+
+class FleetScraper:
+    """Poll a replica set, compute the rollup, track the SLO, append the
+    ``fleet.jsonl`` timeseries. ``scrape_once()`` is the unit of work;
+    ``start()`` runs it on an interval from a daemon thread
+    ("fleet-scrape") for long-lived supervisors."""
+
+    def __init__(self, endpoints: Sequence[str], *,
+                 slo: Optional[SLOPolicy] = None,
+                 fleet_path: Optional[str] = None,
+                 timeout_s: float = 2.0,
+                 interval_s: float = 5.0):
+        self.endpoints = list(endpoints)
+        self.slo = slo
+        self.fleet_path = fleet_path
+        self.timeout_s = float(timeout_s)
+        self.interval_s = max(float(interval_s), 0.05)
+        self.polls = 0
+        self.breaches = 0
+        self.last_rollup: Optional[Dict[str, Any]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- poll
+    def scrape_once(self) -> Dict[str, Any]:
+        samples = [scrape_replica(u, self.timeout_s)
+                   for u in self.endpoints]
+        rollup = compute_rollup(samples, self.slo)
+        rollup["per_replica"] = [
+            {k: s.get(k) for k in ("url", "replica", "run_id", "status")
+             if s.get(k) is not None}
+            for s in samples]
+        self.polls += 1
+        self.last_rollup = rollup
+        verdict = rollup.get("slo") or {}
+        if verdict.get("breach"):
+            self.breaches += 1
+            for signal, flag in (("p99", "p99_breach"),
+                                 ("error_rate", "error_breach")):
+                if verdict.get(flag):
+                    _flight_record(
+                        "slo_breach", signal=signal,
+                        p99_ms=verdict["p99_ms"],
+                        p99_budget_ms=verdict["p99_budget_ms"],
+                        error_rate=verdict["error_rate"],
+                        error_rate_budget=verdict["error_rate_budget"],
+                        qps_total=rollup["qps_total"],
+                        replicas=rollup["replicas"])
+        if self.fleet_path:
+            self._append(rollup)
+        return rollup
+
+    def _append(self, rollup: Dict[str, Any]) -> None:
+        d = os.path.dirname(os.path.abspath(self.fleet_path))
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(self.fleet_path, "a") as f:
+                f.write(json.dumps(rollup) + "\n")
+        except OSError as e:
+            # a missed timeseries row is not a scrape failure
+            self.last_write_error = repr(e)
+
+    # ------------------------------------------------------- background
+    def _run(self) -> None:
+        self.scrape_once()
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception as e:  # noqa: BLE001 - keep polling
+                self.last_poll_error = repr(e)
+
+    def start(self) -> "FleetScraper":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-scrape", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

@@ -115,11 +115,25 @@ class SpanTracer:
     def dump(self, path: str) -> str:
         """Write ``trace.json`` (Chrome trace-event JSON). Loadable by
         Perfetto / chrome://tracing; ``tools/obs_report.py`` renders the
-        phase breakdown from the same file."""
+        phase breakdown from the same file; ``tools/trace_merge.py``
+        joins per-replica dumps by the identity stamped here."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        doc = {"traceEvents": self.events(), "displayTimeUnit": "ms",
-               "otherData": {"recorded": self.recorded,
-                             "dropped": self.dropped}}
+        events = self.events()
+        other: Dict[str, Any] = {"recorded": self.recorded,
+                                 "dropped": self.dropped}
+        run_id = os.environ.get("DLTPU_RUN_ID")
+        replica = os.environ.get("DLTPU_REPLICA")
+        if run_id:
+            other["run_id"] = run_id
+        if replica is not None and replica != "":
+            other["replica"] = replica
+            # name the process row so a merged fleet timeline shows
+            # "replica-N" instead of a bare pid
+            events.insert(0, {
+                "ph": "M", "name": "process_name", "pid": os.getpid(),
+                "tid": 0, "args": {"name": f"replica-{replica}"}})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": other}
         with open(path, "w") as f:
             json.dump(doc, f)
         return path

@@ -26,7 +26,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import flight, spans
+from . import flight, metrics, spans
 
 __all__ = ["tracked_compile", "compile_events", "compile_stats",
            "memory_analysis_dict", "hbm_snapshot", "HbmWatermark",
@@ -117,6 +117,10 @@ def tracked_compile(lowered, name: str):
                            ("seconds", "flops", "peak_hbm_bytes",
                             "cache_hit")})
         flight.record("compile", **event)
+        metrics.inc("dltpu_compiles_total")
+        metrics.inc("dltpu_compile_seconds_total", seconds)
+        if cache_hit:
+            metrics.inc("dltpu_compile_cache_hits_total")
     except Exception:  # noqa: BLE001 - telemetry never fails a compile
         pass
     return compiled
@@ -283,6 +287,11 @@ class HbmWatermark:
                            "live_count":
                                snap.get("live_arrays", {}).get("count", 0),
                            "peak_live_bytes": self.peak_live_bytes})
+        metrics.set_gauge("dltpu_hbm_live_bytes", float(live))
+        metrics.set_gauge("dltpu_hbm_peak_live_bytes",
+                          float(self.peak_live_bytes))
+        metrics.set_gauge("dltpu_hbm_peak_bytes_in_use",
+                          float(self.peak_bytes_in_use))
 
     def _run(self) -> None:
         self._sample()                       # guaranteed first point

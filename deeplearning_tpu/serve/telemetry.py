@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Dict, Optional, Sequence
 
 __all__ = ["ServeTelemetry", "percentile"]
@@ -51,6 +52,13 @@ class ServeTelemetry:
         self._batch_real = collections.deque(maxlen=ring)
         self._batch_bucket = collections.deque(maxlen=ring)
         self._queue_depth = collections.deque(maxlen=ring)
+        # event timestamps for windowed rates (the fleet aggregator sums
+        # rates across replicas — cumulative counters alone can't say
+        # "QPS now"). Same bounded-ring discipline: one append per event.
+        self._submit_ts = collections.deque(maxlen=ring)
+        self._reject_ts = collections.deque(maxlen=ring)
+        self._complete_ts = collections.deque(maxlen=ring)
+        self._born = time.monotonic()
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -62,10 +70,12 @@ class ServeTelemetry:
     def record_submit(self) -> None:
         with self._lock:
             self.submitted += 1
+            self._submit_ts.append(time.monotonic())
 
     def record_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+            self._reject_ts.append(time.monotonic())
 
     def record_timeout(self, n: int = 1) -> None:
         with self._lock:
@@ -87,6 +97,9 @@ class ServeTelemetry:
         with self._lock:
             self.completed += n
             self._dispatch_lat.append(float(seconds))
+            now = time.monotonic()
+            for _ in range(n):
+                self._complete_ts.append(now)
 
     def record_e2e_latency(self, seconds: float) -> None:
         with self._lock:
@@ -117,6 +130,28 @@ class ServeTelemetry:
             ring = self._queue_depth
             return sum(ring) / len(ring) if ring else 0.0
 
+    def rates(self, window_s: float = 10.0) -> Dict[str, float]:
+        """{requests_per_s, rejects_per_s, completions_per_s} over the
+        trailing ``window_s``. The divisor is the *effective* window —
+        min(window_s, age of this telemetry object) — so a short burst
+        right after startup measures its true rate instead of being
+        diluted by a window that predates the process."""
+        now = time.monotonic()
+        cut = now - window_s
+        eff = max(min(window_s, now - self._born), 1e-6)
+        with self._lock:
+            counts = {
+                "requests_per_s": sum(1 for t in self._submit_ts
+                                      if t >= cut),
+                "rejects_per_s": sum(1 for t in self._reject_ts
+                                     if t >= cut),
+                "completions_per_s": sum(1 for t in self._complete_ts
+                                         if t >= cut),
+            }
+        out = {k: round(v / eff, 3) for k, v in counts.items()}
+        out["window_s"] = round(eff, 3)
+        return out
+
     def snapshot(self) -> Dict[str, float]:
         """One flat dict for bench rows / the serve CLI stats line."""
         disp = self.latency_ms("dispatch")
@@ -132,6 +167,7 @@ class ServeTelemetry:
             }
         out["batch_occupancy"] = round(self.batch_occupancy, 4)
         out["queue_depth_mean"] = round(self.queue_depth_mean, 2)
+        out.update(self.rates())
         for k, v in disp.items():
             out[f"dispatch_ms_{k}"] = v
         for k, v in e2e.items():

@@ -36,6 +36,7 @@ from ..elastic import heartbeat as hb
 from ..elastic.preempt import (Preempted, PreemptionGuard,
                                agree_preempt_step)
 from ..obs import flight
+from ..obs import metrics as obs_metrics
 from ..obs.spans import span, step_span
 from ..utils.profiling import RetraceGuard
 from . import recovery as recovery_mod
@@ -106,6 +107,7 @@ class Trainer:
         heartbeat="auto",
         recovery=None,
         strict=None,
+        metrics_port="auto",
     ):
         self.state = state
         # strict mode (README "Hot-loop sync policy"): arm JAX's own
@@ -162,6 +164,20 @@ class Trainer:
         self._hbm = None
         self._obs_owns_tracer = False
         self._obs_started = False
+        # fleet scrape surface: "auto" serves /metrics + /healthz only
+        # when DLTPU_METRICS_PORT names a port (the supervisor/fleet
+        # contract); an int forces that port (0 = ephemeral); None/False
+        # disables. Train replicas then answer the same probes serve
+        # replicas do.
+        if metrics_port == "auto":
+            raw = os.environ.get("DLTPU_METRICS_PORT")
+            self.metrics_port = int(raw) if raw not in (None, "") else None
+        else:
+            self.metrics_port = (int(metrics_port)
+                                 if metrics_port not in (None, False)
+                                 else None)
+        self._metrics_server = None
+        self._owns_metrics_registry = False
         self.train_step = (RetraceGuard(
             train_step, name="train_step",
             on_retrace=lambda info: flight.record("retrace", **info))
@@ -312,6 +328,28 @@ class Trainer:
             flight.install_signal_handler()
         self._hbm = HbmWatermark(interval_s=self.hbm_sample_s,
                                  alert_frac=self.hbm_alert_frac).start()
+        # metrics registry: always on with obs (the push helpers in
+        # _consume/feed/recovery need a home); the HTTP scrape server
+        # only when a port was asked for
+        self._owns_metrics_registry = not obs_metrics.enabled()
+        obs_metrics.enable()
+        if self.metrics_port is not None and self._metrics_server is None:
+            self._metrics_server = obs_metrics.MetricsServer(
+                port=self.metrics_port,
+                healthz_fn=self._metrics_healthz).start()
+            obs_metrics.write_endpoint(self._metrics_server.url,
+                                       role="train")
+
+    def _metrics_healthz(self):
+        """Train-replica health: backed by the elastic heartbeat — the
+        same step/activity watermark the supervisor's wedge detector
+        reads, so /healthz and the heartbeat file never disagree."""
+        payload = {"status": "ready", **obs_metrics.replica_identity()}
+        if self._beat is not None:
+            payload["step"] = self._beat.step
+            payload["activity"] = self._beat.activity
+            payload["phase"] = self._beat.phase
+        return 200, payload
 
     def _obs_finish(self) -> None:
         if not self.obs_enabled:
@@ -325,6 +363,14 @@ class Trainer:
             tracer.dump(os.path.join(self.workdir, "trace.json"))
         if self._obs_owns_tracer:
             spans.disable()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        reg = obs_metrics.get_registry()
+        if reg is not None and self.workdir:
+            reg.dump(os.path.join(self.workdir, "metrics_registry.json"))
+        if self._owns_metrics_registry:
+            obs_metrics.disable()
         self._obs_started = False      # a second train() re-arms
 
     # ---------------------------------------------------------- elastic
@@ -577,6 +623,9 @@ class Trainer:
                              self.host_step)
             if self.obs_enabled:
                 flight.record("feed", epoch=epoch, **stats)
+                for k, v in stats.items():
+                    if isinstance(v, (int, float)):
+                        obs_metrics.set_gauge(f"dltpu_feed_{k}", float(v))
             reset = getattr(self.train_loader, "reset_stats", None)
             if reset is not None:
                 reset()
@@ -638,6 +687,14 @@ class Trainer:
             f"{self.meters}")
         self.hub.scalars({f"train/{k}": v for k, v in host.items()},
                          meta["step"])
+        # scrape surface: the same lagged (already-resolved) snapshot —
+        # no extra D2H, the fleet sees exactly what the log line sees
+        if meta.get("step") is not None:
+            obs_metrics.set_gauge("dltpu_train_step", float(meta["step"]))
+        for k, v in host.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                safe = "".join(c if c.isalnum() else "_" for c in str(k))
+                obs_metrics.set_gauge(f"dltpu_train_{safe}", float(v))
 
     # ---------------------------------------------------------- recovery
     def _rollback(self, d: _DivergenceDetected) -> None:
@@ -679,6 +736,7 @@ class Trainer:
             + f"lr x{pol.lr_decay} for {pol.cooldown_steps} steps "
             f"({len(self._recovery.recovery_steps)}/{pol.max_recoveries} "
             f"recoveries used)")
+        obs_metrics.inc("dltpu_recovery_rollbacks_total")
         if self.obs_enabled:
             flight.record("recovery", step=bad_step,
                           anchor_step=anchor_step, loss=host.get("loss"),

@@ -143,6 +143,46 @@ def obs_overhead(step_fn, args, n=30, reps=3, budget_pct=2.0):
     }
 
 
+def metrics_overhead(step_fn, args, n=30, reps=3, budget_pct=2.0):
+    """A/B the metrics-instrumented hot loop: the same ``step_fn(*args)``
+    loop with the registry disabled vs enabled, each step paying the
+    per-step push a real instrumented loop pays (one counter ``inc`` +
+    one histogram ``observe``). Min-of-reps per arm, same <=2% contract
+    shape as ``obs_overhead`` — the fleet scrape surface must cost no
+    more than the span tracer it sits next to."""
+    from deeplearning_tpu.obs import metrics
+
+    def loop():
+        out = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            metrics.inc("dltpu_bench_steps_total")
+            metrics.observe("dltpu_bench_step_ms", float(i))
+            out = step_fn(*args)
+        sync(out)
+        return time.perf_counter() - t0
+
+    sync(step_fn(*args))           # warmup: compile once
+    was_enabled = metrics.enabled()
+    off = on = float("inf")
+    try:
+        for _ in range(reps):
+            metrics.disable()
+            off = min(off, loop())
+            metrics.enable()
+            on = min(on, loop())
+    finally:
+        metrics.enable() if was_enabled else metrics.disable()
+    overhead_pct = (on - off) / off * 100.0 if off > 0 else 0.0
+    return {
+        "metrics_off_ms": round(off / n * 1e3, 4),
+        "metrics_on_ms": round(on / n * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "within_budget": overhead_pct <= budget_pct,
+        "budget_pct": budget_pct,
+    }
+
+
 def recovery_overhead(step_fn, args, state, n=30, reps=3, budget_pct=2.0):
     """A/B the self-healing hooks' IDLE cost: the same ``step_fn(*args)``
     loop bare vs with the Trainer's per-step recovery hooks — the

@@ -3,6 +3,7 @@
 
   python tools/obs_report.py runs/exp1             # text report
   python tools/obs_report.py runs/exp1 --json      # machine-readable
+  python tools/obs_report.py runs/fleet --fleet    # fleet rollup view
   python tools/obs_report.py --check               # self-test (tier-1)
 
 Consumes what the Trainer writes per run — ``trace.json`` (the span
@@ -59,6 +60,89 @@ def load_supervisor(run_dir: str) -> Optional[Dict[str, Any]]:
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def load_registry(run_dir: str) -> Optional[Dict[str, Any]]:
+    """The metrics-registry snapshot a Trainer dumps at obs shutdown
+    (``metrics_registry.json``) — the same state /metrics exposed live."""
+    path = os.path.join(run_dir, "metrics_registry.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def load_fleet(run_dir: str) -> List[Dict[str, Any]]:
+    """The ``fleet.jsonl`` rollup timeseries an ``obs/fleet.py`` scraper
+    appended while polling this run's replicas."""
+    path = os.path.join(run_dir, "fleet.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    return rows
+
+
+def registry_summary(reg: Optional[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """Registry section: identity + scalar values of every dltpu_*
+    counter/gauge (histograms reduce to count/sum)."""
+    if not reg:
+        return None
+    out: Dict[str, Any] = {
+        k: reg[k] for k in ("run_id", "replica") if k in reg}
+    out["collect_errors"] = reg.get("collect_errors", 0)
+    values: Dict[str, Any] = {}
+    for name, sample in sorted((reg.get("metrics") or {}).items()):
+        if not isinstance(sample, dict):
+            continue
+        if sample.get("type") == "histogram":
+            values[name] = {"count": sample.get("count"),
+                            "sum": sample.get("sum")}
+        elif "value" in sample:
+            values[name] = sample["value"]
+    out["metrics"] = values
+    return out if values or len(out) > 1 else None
+
+
+def fleet_summary(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Fleet section: last rollup + run peaks + SLO burn, from the
+    fleet.jsonl timeseries. Pure — tests drive it with synthetic rows."""
+    if not rows:
+        return None
+    last = rows[-1]
+    breaches = [r for r in rows if (r.get("slo") or {}).get("breach")]
+    out: Dict[str, Any] = {
+        "polls": len(rows),
+        "replicas": last.get("replicas"),
+        "replica_status": last.get("replica_status"),
+        "qps_total_last": last.get("qps_total"),
+        "qps_total_peak": max((r.get("qps_total", 0.0) for r in rows),
+                              default=0.0),
+        "e2e_ms_p99_max_last": last.get("e2e_ms_p99_max"),
+        "e2e_ms_p99_max_peak": max(
+            (r.get("e2e_ms_p99_max", 0.0) for r in rows), default=0.0),
+        "queue_depth_total_last": last.get("queue_depth_total"),
+        "error_rate_last": last.get("error_rate"),
+        "slo_breach_polls": len(breaches),
+    }
+    slo = last.get("slo")
+    if slo:
+        out["slo"] = {k: slo.get(k) for k in
+                      ("p99_budget_ms", "error_rate_budget", "breach",
+                       "p99_breach", "error_breach")}
+    return out
 
 
 def load_metrics(run_dir: str) -> List[Dict[str, Any]]:
@@ -165,6 +249,14 @@ def summarize(run_dir: str) -> Dict[str, Any]:
             out["metrics"]["last"] = {
                 k: v for k, v in last.items()
                 if isinstance(v, (int, float)) and k != "time"}
+
+    registry = registry_summary(load_registry(run_dir))
+    if registry:
+        out["registry"] = registry
+
+    fleet = fleet_summary(load_fleet(run_dir))
+    if fleet:
+        out["fleet"] = fleet
 
     analysis = analysis_summary()
     if analysis:
@@ -377,6 +469,46 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append(f"metrics.jsonl: {m['rows']} rows"
                      + (f", last step {m['last']}" if m.get("last")
                         else ""))
+    reg = summary.get("registry")
+    if reg:
+        lines.append("")
+        ident = " ".join(
+            f"{k}={reg[k]}" for k in ("run_id", "replica")
+            if reg.get(k) is not None)
+        lines.append(
+            f"registry: {len(reg['metrics'])} metric(s)"
+            + (f" [{ident}]" if ident else "")
+            + (f" collect_errors={reg['collect_errors']}"
+               if reg.get("collect_errors") else ""))
+        notable = ("dltpu_train_step", "dltpu_compiles_total",
+                   "dltpu_serve_requests_total",
+                   "dltpu_serve_completed_total",
+                   "dltpu_recovery_rollbacks_total",
+                   "dltpu_quarantine_total")
+        picks = [f"{n}={reg['metrics'][n]}" for n in notable
+                 if n in reg["metrics"]]
+        if picks:
+            lines.append("  " + "  ".join(picks))
+    ft = summary.get("fleet")
+    if ft:
+        lines.append("")
+        lines.append(
+            f"fleet: {ft['polls']} poll(s), {ft['replicas']} replica(s) "
+            f"{ft.get('replica_status') or {}}")
+        lines.append(
+            f"  qps={ft.get('qps_total_last') or 0.0:.1f} "
+            f"(peak {ft.get('qps_total_peak') or 0.0:.1f})  "
+            f"p99={ft.get('e2e_ms_p99_max_last') or 0.0:.1f}ms "
+            f"(peak {ft.get('e2e_ms_p99_max_peak') or 0.0:.1f}ms)  "
+            f"queue={ft.get('queue_depth_total_last') or 0.0:.0f}  "
+            f"err={ft.get('error_rate_last') or 0.0:.4f}")
+        slo = ft.get("slo")
+        if ft.get("slo_breach_polls") or (slo and slo.get("breach")):
+            budgets = (f"p99<={slo['p99_budget_ms']}ms "
+                       f"err<={slo['error_rate_budget']}" if slo else "?")
+            lines.append(
+                f"  SLO: {ft['slo_breach_polls']}/{ft['polls']} poll(s) "
+                f"in breach (budget {budgets})")
     a = summary.get("analysis")
     if a:
         lines.append("")
@@ -384,6 +516,47 @@ def render(summary: Dict[str, Any]) -> str:
             f"analysis: {a['rules']} DLT rules enabled, baseline "
             f"{a['baseline_findings']} finding(s) in "
             f"{a['baseline_files']} file(s) (tools/check.py --ci)")
+    return "\n".join(lines)
+
+
+def render_fleet(run_dir: str) -> str:
+    """``--fleet`` view: the rollup timeseries a scraper appended to
+    ``fleet.jsonl`` in a fleet workdir, one line per poll, plus the
+    summary footer. Pure file reads."""
+    rows = load_fleet(run_dir)
+    lines = [f"fleet: {run_dir}"]
+    if not rows:
+        lines.append("  no fleet.jsonl (run obs/fleet.FleetScraper or "
+                     "tools/supervise.py --replicas N first)")
+        return "\n".join(lines)
+    t0 = rows[0].get("time") or 0.0
+    lines.append("")
+    lines.append(f"{'t(s)':>7s} {'rep':>4s} {'qps':>8s} {'rej/s':>7s} "
+                 f"{'p99 ms':>8s} {'queue':>6s} {'err':>7s}  slo")
+    for r in rows:
+        slo = r.get("slo") or {}
+        verdict = "BREACH" if slo.get("breach") else (
+            "ok" if slo else "-")
+        if slo.get("breach"):
+            which = [k for k in ("p99_breach", "error_breach")
+                     if slo.get(k)]
+            verdict += f" ({', '.join(w.split('_')[0] for w in which)})"
+        lines.append(
+            f"{(r.get('time') or 0.0) - t0:>7.1f} "
+            f"{r.get('replicas', 0):>4d} "
+            f"{r.get('qps_total', 0.0):>8.1f} "
+            f"{r.get('rejects_per_s_total', 0.0):>7.1f} "
+            f"{r.get('e2e_ms_p99_max', 0.0):>8.1f} "
+            f"{r.get('queue_depth_total', 0.0):>6.0f} "
+            f"{r.get('error_rate', 0.0):>7.4f}  {verdict}")
+    ft = fleet_summary(rows) or {}
+    lines.append("")
+    lines.append(
+        f"{ft.get('polls', 0)} poll(s); peak qps "
+        f"{ft.get('qps_total_peak') or 0.0:.1f}, peak p99 "
+        f"{ft.get('e2e_ms_p99_max_peak') or 0.0:.1f} ms; "
+        f"{ft.get('slo_breach_polls', 0)} poll(s) in SLO breach; "
+        f"last status {ft.get('replica_status') or {}}")
     return "\n".join(lines)
 
 
@@ -458,6 +631,37 @@ def _check() -> int:
             f.write(json.dumps({"step": 2, "time": 0.1,
                                 "train/loss": 1e9}) + "\n")
 
+        # metrics-registry snapshot through the real registry API (the
+        # file a Trainer dumps at obs shutdown)
+        from deeplearning_tpu.obs import fleet as fleet_mod
+        from deeplearning_tpu.obs.metrics import MetricsRegistry
+        regy = MetricsRegistry()
+        regy.counter("dltpu_serve_requests_total").inc(42)
+        regy.counter("dltpu_recovery_rollbacks_total").inc()
+        regy.gauge("dltpu_train_step").set(17)
+        regy.histogram("dltpu_step_ms", buckets=(1.0, 10.0)).observe(3.0)
+        regy.dump(os.path.join(run_dir, "metrics_registry.json"))
+
+        # fleet.jsonl through the real rollup/SLO fold: one healthy
+        # poll, one p99 breach
+        def _fsample(i, qps, p99):
+            return {"url": f"http://127.0.0.1:900{i}", "ok": True,
+                    "status": "ready", "replica": str(i),
+                    "metrics": {"dltpu_serve_requests_per_s": qps,
+                                "dltpu_serve_e2e_ms_p99": p99,
+                                "dltpu_serve_queue_depth": 1.0,
+                                "dltpu_serve_requests_total": 100.0,
+                                "dltpu_serve_completed_total": 99.0,
+                                "dltpu_serve_rejected_total": 1.0,
+                                "dltpu_serve_timed_out_total": 0.0}}
+        slo = fleet_mod.SLOPolicy(p99_budget_ms=10.0,
+                                  error_rate_budget=0.5)
+        with open(os.path.join(run_dir, "fleet.jsonl"), "w") as f:
+            for samples in ([_fsample(0, 5.0, 4.0), _fsample(1, 7.0, 6.0)],
+                            [_fsample(0, 9.0, 40.0), _fsample(1, 7.0, 6.0)]):
+                f.write(json.dumps(
+                    fleet_mod.compute_rollup(samples, slo)) + "\n")
+
         summary = summarize(run_dir)
         report = render(summary)
 
@@ -493,6 +697,28 @@ def _check() -> int:
                       "quarantined=1", "sharding: weight_update=zero1",
                       "collective_bytes/step=1252352"):
             assert token in report, report
+        # registry + fleet sections (the new telemetry plane files)
+        rg = summary["registry"]
+        assert rg["metrics"]["dltpu_serve_requests_total"] == 42.0, rg
+        assert rg["metrics"]["dltpu_train_step"] == 17.0, rg
+        assert rg["metrics"]["dltpu_step_ms"] == \
+            {"count": 1, "sum": 3.0}, rg
+        assert rg["collect_errors"] == 0, rg
+        ftl = summary["fleet"]
+        assert ftl["polls"] == 2 and ftl["replicas"] == 2, ftl
+        assert ftl["replica_status"] == {"ready": 2}, ftl
+        assert abs(ftl["qps_total_last"] - 16.0) < 1e-9, ftl
+        assert abs(ftl["e2e_ms_p99_max_peak"] - 40.0) < 1e-9, ftl
+        assert ftl["slo_breach_polls"] == 1, ftl
+        assert ftl["slo"]["p99_breach"] and ftl["slo"]["breach"], ftl
+        for token in ("registry: 4 metric(s)",
+                      "dltpu_serve_requests_total=42.0",
+                      "fleet: 2 poll(s), 2 replica(s)",
+                      "SLO: 1/2 poll(s) in breach"):
+            assert token in report, report
+        fleet_view = render_fleet(run_dir)
+        assert "BREACH (p99)" in fleet_view, fleet_view
+        assert fleet_view.count("\n") >= 5, fleet_view
         # dltpu-check posture line: rules enabled + committed baseline
         ana = summary["analysis"]
         assert ana["rules"] >= 6, ana
@@ -511,6 +737,8 @@ def main(argv=None) -> int:
                     help="emit the summary as JSON")
     ap.add_argument("--check", action="store_true",
                     help="self-test on a synthetic run dir")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the fleet.jsonl rollup timeseries")
     args = ap.parse_args(argv)
     if args.check:
         return _check()
@@ -519,6 +747,12 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"not a directory: {args.run_dir}", file=sys.stderr)
         return 2
+    if args.fleet:
+        rows = load_fleet(args.run_dir)
+        print(json.dumps({"rows": rows,
+                          "summary": fleet_summary(rows)}, indent=1)
+              if args.json else render_fleet(args.run_dir))
+        return 0
     summary = summarize(args.run_dir)
     print(json.dumps(summary, indent=1) if args.json
           else render(summary))

@@ -15,6 +15,13 @@ clients batch together, admission control applies (full queue answers
 "rejected" with a retry-after hint instead of queueing unboundedly),
 and the model only ever executes its warmed bucket shapes. ``GET
 /stats`` (HTTP) or EOF (stdin) reports the telemetry snapshot.
+
+Fleet plane: HTTP mode always exposes ``GET /metrics`` (Prometheus
+text format, the uniform schema ``obs/fleet.py`` scrapes) and
+``GET /metrics.json``; when a supervisor hands down
+``DLTPU_ENDPOINT_FILE`` the replica advertises its URL there, and
+``DLTPU_TRACE=1`` enables the span tracer with a ``trace.json`` dump on
+graceful shutdown (SIGTERM drains the server instead of killing it).
 """
 
 from __future__ import annotations
@@ -124,6 +131,46 @@ def serve_stdin(batcher, task: str, size: int, names, topk: int,
     return 0
 
 
+def make_metrics_collector(batcher):
+    """Scrape-time pull adapter: mirror ``ServeTelemetry.snapshot()``
+    (rates, percentiles, cumulative counts) and ``engine.stats()`` into
+    the registry under the ``dltpu_serve_*`` names ``obs/fleet.py``
+    rolls up. Counters use ``set_total`` (monotonic mirror); xla-side
+    compile/HBM metrics are PUSHED by obs.xla and deliberately not
+    mirrored here — one writer per metric, never two."""
+    counter_names = {
+        "submitted": "dltpu_serve_requests_total",
+        "completed": "dltpu_serve_completed_total",
+        "rejected": "dltpu_serve_rejected_total",
+        "timed_out": "dltpu_serve_timed_out_total",
+        "batches": "dltpu_serve_batches_total",
+        "shed_batches": "dltpu_serve_shed_batches_total",
+    }
+
+    def _collect(reg):
+        snap = batcher.telemetry.snapshot()
+        for key, name in counter_names.items():
+            reg.counter(name, f"serve telemetry {key}").set_total(
+                snap.get(key, 0.0))
+        for key in ("requests_per_s", "rejects_per_s",
+                    "completions_per_s", "window_s", "batch_occupancy",
+                    "queue_depth_mean", "e2e_ms_p50", "e2e_ms_p90",
+                    "e2e_ms_p99", "dispatch_ms_p50", "dispatch_ms_p90",
+                    "dispatch_ms_p99"):
+            if key in snap:
+                reg.gauge(f"dltpu_serve_{key}",
+                          f"serve telemetry {key}").set(snap[key])
+        reg.gauge("dltpu_serve_queue_depth",
+                  "live micro-batch queue depth").set(
+            float(batcher.queue_depth))
+        for key, val in batcher.engine.stats().items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                safe = "".join(c if c.isalnum() else "_" for c in key)
+                reg.gauge(f"dltpu_engine_{safe}",
+                          f"engine stats {key}").set(float(val))
+    return _collect
+
+
 def serve_http(batcher, task: str, size: int, names, topk: int,
                timeout_s: float, port: int,
                wedge_deadline_s: float = 30.0):
@@ -132,17 +179,21 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     verdict, including the DispatchWatch wedge check (requests queued
     while the dispatch counter is frozen past ``wedge_deadline_s`` →
     503 with ``"wedged": true``, so a balancer drains a stuck replica
-    the process itself cannot notice). ThreadingHTTPServer gives each
-    request its own thread, so concurrent posts micro-batch."""
+    the process itself cannot notice); GET /metrics + /metrics.json →
+    the fleet scrape surface. ThreadingHTTPServer gives each request
+    its own thread, so concurrent posts micro-batch."""
     import io
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from deeplearning_tpu.obs import metrics as obs_metrics
     from deeplearning_tpu.obs import xla as obs_xla
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
     from deeplearning_tpu.serve.health import DispatchWatch
     from deeplearning_tpu.serve.health import health as health_check
 
     watch = DispatchWatch(batcher, wedge_deadline_s)
+    registry = obs_metrics.enable()
+    registry.register_collector(make_metrics_collector(batcher))
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):   # quiet: telemetry is the log
@@ -167,8 +218,22 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
             if route == "/healthz":
                 code, payload = health_check(batcher.engine, batcher,
                                              wedge=watch)
+                payload.update(obs_metrics.replica_identity())
                 return self._json(code, payload)
-            return self._json(404, {"error": "GET /stats or /healthz"})
+            if route == "/metrics":
+                body = registry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            if route == "/metrics.json":
+                return self._json(200, registry.snapshot())
+            return self._json(404, {"error": "GET /stats, /healthz, "
+                                             "/metrics or /metrics.json"})
 
         def do_POST(self):
             if self.path.rstrip("/") != "/predict":
@@ -196,8 +261,12 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                 format_answer(task, row, names, topk) for row in rows]})
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    print(json.dumps({"serving": f"http://127.0.0.1:{server.server_port}",
-                      "endpoints": ["/predict", "/stats", "/healthz"]}),
+    url = f"http://127.0.0.1:{server.server_port}"
+    # advertise the scrape endpoint when a supervisor asked for it
+    obs_metrics.write_endpoint(url, role="serve")
+    print(json.dumps({"serving": url,
+                      "endpoints": ["/predict", "/stats", "/healthz",
+                                    "/metrics", "/metrics.json"]}),
           flush=True)
     return server
 
@@ -232,7 +301,18 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from deeplearning_tpu.elastic import heartbeat as hb
+    from deeplearning_tpu.obs import spans
     from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+
+    # DLTPU_TRACE=1: record the span timeline and dump trace.json on
+    # graceful exit (next to the endpoint file when supervised, so
+    # tools/trace_merge.py finds one trace per replica workdir)
+    trace_path = None
+    if os.environ.get("DLTPU_TRACE"):
+        spans.enable()
+        ep = os.environ.get("DLTPU_ENDPOINT_FILE")
+        trace_path = os.environ.get("DLTPU_TRACE_FILE") or os.path.join(
+            os.path.dirname(ep) if ep else ".", "trace.json")
 
     engine = InferenceEngine(
         args.model, num_classes=args.num_classes, ckpt=args.ckpt,
@@ -265,6 +345,22 @@ def main(argv=None) -> int:
                 server = serve_http(batcher, engine.task, args.size,
                                     names, args.topk, args.timeout_s,
                                     args.http, args.wedge_deadline_s)
+
+                # SIGTERM (the supervisor's drain signal) shuts the
+                # server down from a helper thread — serve_forever
+                # returns, the trace dumps, the heartbeat finalizes —
+                # instead of the default die-mid-request
+                import signal
+                import threading
+
+                def _drain(signum, frame):
+                    threading.Thread(target=server.shutdown,
+                                     name="serve-drain",
+                                     daemon=True).start()
+                try:
+                    signal.signal(signal.SIGTERM, _drain)
+                except ValueError:
+                    pass           # non-main thread (embedded use)
                 try:
                     server.serve_forever()
                 except KeyboardInterrupt:
@@ -275,6 +371,10 @@ def main(argv=None) -> int:
             return serve_stdin(batcher, engine.task, args.size, names,
                                args.topk, args.timeout_s)
     finally:
+        if trace_path is not None:
+            tracer = spans.get_tracer()
+            if tracer is not None:
+                tracer.dump(trace_path)
         if writer is not None:
             writer.stop()
 

@@ -14,6 +14,14 @@ Usage:
 
 The training command must checkpoint into a stable workdir — resume is
 the child's own auto-resume; the supervisor only restarts it.
+
+Fleet mode (``--replicas N``): launch N copies of the command, each
+under its own Supervisor thread in ``<workdir>/replica-<i>/``, all
+sharing one ``DLTPU_RUN_ID`` and each handed its ``DLTPU_REPLICA``
+index + ``DLTPU_ENDPOINT_FILE`` — the identity contract the heartbeat
+files, ``/metrics`` exposition, and trace dumps all stamp, and the one
+``obs/fleet.py`` discovery + ``tools/trace_merge.py`` join on. Exit
+code is the worst replica's.
 """
 
 from __future__ import annotations
@@ -21,6 +29,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import uuid
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -48,6 +57,12 @@ def main(argv=None) -> int:
     parser.add_argument("--kill-grace", type=float, default=10.0,
                         help="seconds between SIGTERM and SIGKILL when "
                              "killing a wedged child")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="launch N supervised replicas of the "
+                             "command under one run id (fleet mode)")
+    parser.add_argument("--run-id", default=None,
+                        help="fleet run id (default: random); exported "
+                             "to children as DLTPU_RUN_ID")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command (prefix with --)")
     args = parser.parse_args(argv)
@@ -57,21 +72,58 @@ def main(argv=None) -> int:
         command = command[1:]
     if not command:
         parser.error("no training command given (put it after --)")
+    if args.replicas < 1:
+        parser.error("--replicas must be >= 1")
 
     from deeplearning_tpu.elastic.supervisor import (Supervisor,
                                                      SupervisorConfig)
-    cfg = SupervisorConfig(
-        command,
-        workdir=args.workdir,
-        max_restarts=args.max_restarts,
-        wedge_deadline_s=args.wedge_deadline,
-        startup_deadline_s=args.startup_deadline,
-        backoff_base_s=args.backoff_base,
-        backoff_factor=args.backoff_factor,
-        backoff_max_s=args.backoff_max,
-        kill_grace_s=args.kill_grace,
-    )
-    return Supervisor(cfg).run()
+
+    def build_cfg(workdir: str, run_id, replica) -> SupervisorConfig:
+        return SupervisorConfig(
+            command,
+            workdir=workdir,
+            max_restarts=args.max_restarts,
+            wedge_deadline_s=args.wedge_deadline,
+            startup_deadline_s=args.startup_deadline,
+            backoff_base_s=args.backoff_base,
+            backoff_factor=args.backoff_factor,
+            backoff_max_s=args.backoff_max,
+            kill_grace_s=args.kill_grace,
+            run_id=run_id,
+            replica=replica,
+        )
+
+    if args.replicas == 1 and args.run_id is None:
+        return Supervisor(build_cfg(args.workdir, None, None)).run()
+
+    import threading
+
+    run_id = args.run_id or f"run-{uuid.uuid4().hex[:8]}"
+    print(f"[supervise] fleet run_id={run_id} "
+          f"replicas={args.replicas} workdir={args.workdir}",
+          file=sys.stderr)
+    rcs = [1] * args.replicas
+
+    def _one(i: int) -> None:
+        cfg = build_cfg(os.path.join(args.workdir, f"replica-{i}"),
+                        run_id, i)
+        try:
+            rcs[i] = Supervisor(cfg).run()
+        except Exception as e:  # noqa: BLE001 - one replica's failure
+            print(f"[supervise] replica {i} supervisor died: {e!r}",
+                  file=sys.stderr)
+            rcs[i] = 1
+
+    threads = [threading.Thread(target=_one, args=(i,),
+                                name=f"supervise-{i}")
+               for i in range(args.replicas)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(f"[supervise] fleet done run_id={run_id} rcs={rcs}",
+          file=sys.stderr)
+    return max(rcs)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Merge per-replica trace.json files into one fleet timeline.
+
+Every replica's span tracer (``obs/spans.py``) dumps Chrome trace-event
+JSON with ABSOLUTE wall-clock microsecond timestamps (epoch-anchored on
+purpose), so merging is pure bookkeeping: no time re-basing, just a pid
+remap so N processes land on N distinct rows. Each input file becomes
+one process row (pid 1..N) named ``replica-<id>`` (from the identity
+``DLTPU_REPLICA`` stamped into ``otherData``) or the file's parent
+directory name, ordered by replica id. The output loads directly in
+Perfetto / chrome://tracing — one timeline across the fleet.
+
+Usage:
+  # explicit files
+  python tools/trace_merge.py --out fleet_trace.json \
+      runs/fleet/replica-0/trace.json runs/fleet/replica-1/trace.json
+
+  # or a fleet workdir (finds trace.json + replica-*/trace.json)
+  python tools/trace_merge.py --out fleet_trace.json runs/fleet
+
+  python tools/trace_merge.py --check   # jax-free self-test
+
+Stdlib-only: never imports jax or the package, so it runs on a machine
+that only has the trace files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace JSON object "
+                         "(no traceEvents)")
+    return doc
+
+
+def replica_label(doc: Dict[str, Any], path: str) -> Tuple[int, str]:
+    """(sort key, row name) for one input trace: the stamped replica id
+    wins; otherwise the parent dir name with an input-order key."""
+    other = doc.get("otherData") or {}
+    replica = other.get("replica")
+    if replica is not None:
+        try:
+            return int(replica), f"replica-{replica}"
+        except (TypeError, ValueError):
+            return 1 << 30, f"replica-{replica}"
+    parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    return 1 << 30, parent or os.path.basename(path)
+
+
+def merge_traces(docs: List[Dict[str, Any]],
+                 labels: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Pure merge: input doc i becomes process row pid=i+1. Original
+    pids (the replicas' real os pids, which can collide across hosts or
+    restarts) are discarded; tids pass through untouched since they only
+    need to be unique within a process row."""
+    if labels is None:
+        labels = [f"replica-{i}" for i in range(len(docs))]
+    events: List[Dict[str, Any]] = []
+    sources = []
+    for i, (doc, label) in enumerate(zip(docs, labels)):
+        pid = i + 1
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid, "tid": 0, "args": {"sort_index": i}})
+        for ev in doc.get("traceEvents", []):
+            # the per-replica process_name row metadata is superseded by
+            # the merged row name above
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue
+            out = dict(ev)
+            out["pid"] = pid
+            events.append(out)
+        other = doc.get("otherData") or {}
+        sources.append({"pid": pid, "label": label,
+                        **{k: other[k] for k in
+                           ("run_id", "replica", "recorded", "dropped")
+                           if k in other}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"merged_from": len(docs), "sources": sources}}
+
+
+def discover_traces(run_dir: str) -> List[str]:
+    """trace.json files under a fleet workdir: the dir itself plus each
+    ``replica-*/`` child, sorted by replica index."""
+    found: List[str] = []
+    direct = os.path.join(run_dir, "trace.json")
+    if os.path.isfile(direct):
+        found.append(direct)
+
+    def _key(name: str):
+        tail = name.rsplit("-", 1)[-1]
+        return (0, int(tail)) if tail.isdigit() else (1, 0)
+
+    try:
+        children = sorted(os.listdir(run_dir), key=_key)
+    except OSError:
+        return found
+    for name in children:
+        p = os.path.join(run_dir, name, "trace.json")
+        if os.path.isfile(p):
+            found.append(p)
+    return found
+
+
+def merge_files(paths: List[str]) -> Dict[str, Any]:
+    loaded = [(load_trace(p), p) for p in paths]
+    ordered = sorted(loaded,
+                     key=lambda dp: replica_label(dp[0], dp[1])[0])
+    docs = [doc for doc, _ in ordered]
+    labels = [replica_label(doc, p)[1] for doc, p in ordered]
+    return merge_traces(docs, labels)
+
+
+def _check() -> int:
+    """Self-test on synthetic per-replica traces (the shape spans.dump
+    writes), asserting the acceptance contract: valid Chrome trace JSON
+    with one distinct process row per input."""
+    def fake(replica: int, pid: int) -> Dict[str, Any]:
+        base = 1_700_000_000_000_000.0 + replica * 10.0
+        return {
+            "traceEvents": [
+                {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                 "args": {"name": f"replica-{replica}"}},
+                {"ph": "M", "name": "thread_name", "pid": pid, "tid": 7,
+                 "args": {"name": "serve-dispatch"}},
+                {"ph": "X", "name": "dispatch", "pid": pid, "tid": 7,
+                 "ts": base, "dur": 1500.0},
+                {"ph": "i", "name": "marker", "pid": pid, "tid": 7,
+                 "ts": base + 2000.0, "s": "t"},
+            ],
+            "displayTimeUnit": "ms",
+            "otherData": {"recorded": 2, "dropped": 0,
+                          "run_id": "run-check", "replica": str(replica)},
+        }
+
+    # colliding original pids on purpose — the remap must not care
+    merged = merge_traces([fake(1, 4242), fake(0, 4242)],
+                          labels=None)
+    # order-by-replica goes through merge_files; here exercise the raw
+    # merge plus a round-trip through real files
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for r in (1, 0):
+            d = os.path.join(td, f"replica-{r}")
+            os.makedirs(d)
+            p = os.path.join(d, "trace.json")
+            with open(p, "w") as f:
+                json.dump(fake(r, 4242), f)
+            paths.append(p)
+        disc = discover_traces(td)
+        assert [os.path.basename(os.path.dirname(p)) for p in disc] == \
+            ["replica-0", "replica-1"], disc
+        merged = merge_files(disc)
+    out = json.loads(json.dumps(merged))     # valid JSON round-trip
+    events = out["traceEvents"]
+    rows = {ev["pid"]: ev["args"]["name"] for ev in events
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    assert rows == {1: "replica-0", 2: "replica-1"}, rows
+    pids = {ev["pid"] for ev in events if ev.get("ph") == "X"}
+    assert pids == {1, 2}, pids
+    # replica-0 sorted first despite being written second
+    sort_idx = {ev["pid"]: ev["args"]["sort_index"] for ev in events
+                if ev.get("name") == "process_sort_index"}
+    assert sort_idx == {1: 0, 2: 1}, sort_idx
+    for ev in events:
+        if ev.get("ph") == "X":
+            assert "ts" in ev and "dur" in ev, ev
+    assert out["otherData"]["merged_from"] == 2
+    print("trace_merge --check: OK (2 process rows, valid trace JSON)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("inputs", nargs="*",
+                    help="trace.json files, or one fleet workdir")
+    ap.add_argument("--out", default="fleet_trace.json",
+                    help="merged output path (- for stdout)")
+    ap.add_argument("--check", action="store_true",
+                    help="run the jax-free self-test and exit")
+    args = ap.parse_args(argv)
+    if args.check:
+        return _check()
+    if not args.inputs:
+        ap.error("no inputs (trace.json files or a fleet workdir)")
+
+    paths: List[str] = []
+    for inp in args.inputs:
+        if os.path.isdir(inp):
+            found = discover_traces(inp)
+            if not found:
+                print(f"trace_merge: no trace.json under {inp}",
+                      file=sys.stderr)
+            paths.extend(found)
+        else:
+            paths.append(inp)
+    if not paths:
+        print("trace_merge: nothing to merge", file=sys.stderr)
+        return 1
+    merged = merge_files(paths)
+    n_rows = merged["otherData"]["merged_from"]
+    if args.out == "-":
+        json.dump(merged, sys.stdout)
+        print(file=sys.stdout)
+    else:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"trace_merge: {n_rows} replica rows, "
+              f"{len(merged['traceEvents'])} events -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
