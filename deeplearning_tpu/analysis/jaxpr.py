@@ -329,6 +329,9 @@ def builtin_audits() -> List[Audit]:
     - one-pass RoIAlign does <=8 gathers (one sampling pass);
     - the mnist train step traces with zero transfer primitives (the
       PR 1 sync-free contract, structural form);
+    - interleaved two-tenant zoo dispatch leaves every engine's
+      trace/compile counters exactly where warmup put them (the
+      per-model zero-recompiles-after-warmup contract of serve/zoo.py);
     - (>= 2 devices only) the zero1 train step compiles to
       reduce-scatter + all-gather with no param-sized all-reduce, with
       the replicated step as the control row that DOES show the
@@ -391,6 +394,43 @@ def builtin_audits() -> List[Audit]:
                      note="hot-loop step: zero transfer primitives")
 
     audits.append(train_step_audit())
+
+    def zoo_multimodel_audit() -> Audit:
+        import numpy as np
+
+        from ..serve import MicroBatcher, ModelZoo
+
+        def extra():
+            zoo = ModelZoo()
+            for alias in ("a", "b"):
+                zoo.register(alias, "mnist_fcn", num_classes=4,
+                             image_size=16, batch_buckets=(1, 2))
+                zoo.load(alias, wait=True)
+            warm = {a: (zoo.engine(a).trace_count,
+                        zoo.engine(a).compile_count) for a in ("a", "b")}
+            img = np.zeros((16, 16, 3), np.float32)
+            with MicroBatcher(zoo=zoo, max_wait_ms=1.0) as mb:
+                handles = [mb.submit(img, model=("a", "b")[i % 2])
+                           for i in range(8)]
+                for h in handles:
+                    h.result(timeout=120.0)
+            ok, row = True, {}
+            for a in ("a", "b"):
+                eng = zoo.engine(a)
+                row[f"{a}_trace_count"] = eng.trace_count
+                row[f"{a}_compile_count"] = eng.compile_count
+                ok &= (eng.trace_count, eng.compile_count) == warm[a]
+            return ok, row
+
+        # the traced fn is a placeholder; the audit's substance is the
+        # extra() pass driving interleaved dispatch through two warm
+        # engines and asserting their counters never move
+        return Audit("zoo_multimodel", lambda x: x + 1,
+                     (jnp.zeros((1,)),), extra=extra,
+                     note="interleaved 2-tenant dispatch: zero "
+                          "retraces after warmup")
+
+    audits.append(zoo_multimodel_audit())
 
     def zero1_audits() -> List[Audit]:
         from ..core.registry import MODELS

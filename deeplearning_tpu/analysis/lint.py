@@ -48,6 +48,7 @@ import dataclasses
 import json
 import os
 import re
+from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -71,6 +72,9 @@ HOT_PATH_MODULES: Tuple[str, ...] = (
     "deeplearning_tpu/data/device_prefetch.py",
     "deeplearning_tpu/serve/batcher.py",
     "deeplearning_tpu/serve/engine.py",
+    # multi-tenant residency manager: the warm-path request() is a dict
+    # lookup on the submit thread, so it carries the same no-sync bar
+    "deeplearning_tpu/serve/zoo.py",
     # fleet telemetry plane: instrumented hot paths call into these, so
     # they must be provably sync-free too (stdlib-only by construction)
     "deeplearning_tpu/obs/metrics.py",
@@ -117,10 +121,40 @@ def _qualname(node: ast.AST) -> Optional[str]:
     return None
 
 
+class _Index:
+    """One breadth-first walk of the module, shared by every rule pass.
+
+    Each rule used to re-run ``ast.walk`` over the full tree (nine walks
+    per file between the passes, alias scan, and parent map); on the
+    190-file tree that dominated ``tools/check.py --ci`` wall time. The
+    index walks once and buckets the node kinds the rules filter on."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.nodes: List[ast.AST] = []
+        self.calls: List[ast.Call] = []
+        self.func_defs: List[ast.AST] = []
+        self.except_handlers: List[ast.ExceptHandler] = []
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        todo: deque = deque([tree])
+        while todo:
+            node = todo.popleft()
+            self.nodes.append(node)
+            if isinstance(node, ast.Call):
+                self.calls.append(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.func_defs.append(node)
+            elif isinstance(node, ast.ExceptHandler):
+                self.except_handlers.append(node)
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                todo.append(child)
+
+
 class _Aliases:
     """Import aliases the rules need to resolve (np, jax, time, ...)."""
 
-    def __init__(self, tree: ast.AST):
+    def __init__(self, nodes: Iterable[ast.AST]):
         self.numpy: set = set()
         self.jax: set = set()
         self.time: set = set()
@@ -128,7 +162,7 @@ class _Aliases:
         self.partial: set = set()      # functools.partial names
         self.functools: set = set()
         self.jax_names: set = set()    # from jax import jit, device_get
-        for node in ast.walk(tree):
+        for node in nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     name = a.asname or a.name
@@ -205,9 +239,9 @@ def _int_tuple(node: Optional[ast.expr]) -> Optional[Tuple[int, ...]]:
 def _scope_walk(body: Sequence[ast.stmt]) -> Iterable[ast.AST]:
     """Walk a function body without descending into nested function /
     class scopes (their loads/stores execute at a different time)."""
-    stack: List[ast.AST] = list(body)
+    stack: deque = deque(body)
     while stack:
-        node = stack.pop(0)
+        node = stack.popleft()
         yield node
         for child in ast.iter_child_nodes(node):
             if not isinstance(child, (ast.FunctionDef,
@@ -216,13 +250,12 @@ def _scope_walk(body: Sequence[ast.stmt]) -> Iterable[ast.AST]:
                 stack.append(child)
 
 
-def _scopes(tree: ast.Module) -> Iterable[Sequence[ast.stmt]]:
+def _scopes(idx: _Index) -> Iterable[Sequence[ast.stmt]]:
     """Module body + every function body (the units DLT101/102 reason
     over)."""
-    yield tree.body
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node.body
+    yield idx.tree.body
+    for node in idx.func_defs:
+        yield node.body
 
 
 def _free_loads(fn: ast.AST) -> set:
@@ -252,12 +285,10 @@ def _free_loads(fn: ast.AST) -> set:
 
 
 # ------------------------------------------------------------ rule passes
-def _rule_dlt100(tree, al, path, add) -> None:
+def _rule_dlt100(idx, al, path, add) -> None:
     if not any(h in path for h in HOT_PATH_MODULES):
         return
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in idx.calls:
         q = _qualname(node.func)
         if q is None:
             continue
@@ -274,8 +305,8 @@ def _rule_dlt100(tree, al, path, add) -> None:
                 f"{q}() on a device value forces a D2H transfer")
 
 
-def _rule_dlt101(tree, al, path, add) -> None:
-    for body in _scopes(tree):
+def _rule_dlt101(idx, al, path, add) -> None:
+    for body in _scopes(idx):
         donating: Dict[str, Tuple[int, ...]] = {}
         donations: List[Tuple[str, int]] = []   # (var, line)
         stores: List[Tuple[str, int]] = []
@@ -329,12 +360,11 @@ def _rule_dlt101(tree, al, path, add) -> None:
                 break          # one finding per donation is enough
 
 
-def _rule_dlt102(tree, al, path, add) -> None:
+def _rule_dlt102(idx, al, path, add) -> None:
     # (a) jit over a closure on scalar-derived locals, no static_argnums
     local_defs: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            local_defs[node.name] = node
+    for node in idx.func_defs:
+        local_defs[node.name] = node
 
     def scalar_derived_names(body) -> set:
         out = set()
@@ -355,7 +385,7 @@ def _rule_dlt102(tree, al, path, add) -> None:
                         out.add(t.id)
         return out
 
-    for body in _scopes(tree):
+    for body in _scopes(idx):
         scalars = scalar_derived_names(body)
         if not scalars:
             continue
@@ -382,12 +412,9 @@ def _rule_dlt102(tree, al, path, add) -> None:
 
     # (b) jit construction inside a loop body (fresh cache/trace per
     # iteration); the nearest enclosing scope boundary wins
-    parents: Dict[ast.AST, ast.AST] = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and _is_jit_ref(node.func, al)):
+    parents = idx.parents
+    for node in idx.calls:
+        if not _is_jit_ref(node.func, al):
             continue
         up = parents.get(node)
         while up is not None:
@@ -402,16 +429,13 @@ def _rule_dlt102(tree, al, path, add) -> None:
             up = parents.get(up)
 
 
-def _rule_dlt103(tree, al, path, add) -> None:
+def _rule_dlt103(idx, al, path, add) -> None:
     defs_by_name: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs_by_name[node.name] = node
+    for node in idx.func_defs:
+        defs_by_name[node.name] = node
 
     handlers: List[ast.AST] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in idx.calls:
         q = _qualname(node.func) or ""
         is_subscribe = q == "subscribe" or q.endswith(".subscribe")
         is_signal = q == "signal.signal" or q.endswith("signal.signal")
@@ -456,11 +480,9 @@ def _rule_dlt103(tree, al, path, add) -> None:
                         "safe inside a registered signal handler")
 
 
-def _rule_dlt104(tree, al, path, add) -> None:
+def _rule_dlt104(idx, al, path, add) -> None:
     broad = {"Exception", "BaseException"}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
+    for node in idx.except_handlers:
         if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
             continue
         t = node.type
@@ -476,21 +498,19 @@ def _rule_dlt104(tree, al, path, add) -> None:
                 "failures silently")
 
 
-def _rule_dlt105(tree, al, path, add) -> None:
+def _rule_dlt105(idx, al, path, add) -> None:
     local_defs: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            local_defs[node.name] = node
+    for node in idx.func_defs:
+        local_defs[node.name] = node
 
     traced: List[ast.AST] = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                if _is_jit_ref(dec, al) or _is_jit_call(dec, al):
-                    traced.append(node)
-                    break
-        if isinstance(node, ast.Call) and _is_jit_ref(node.func, al) \
-                and node.args:
+    for node in idx.func_defs:
+        for dec in node.decorator_list:
+            if _is_jit_ref(dec, al) or _is_jit_call(dec, al):
+                traced.append(node)
+                break
+    for node in idx.calls:
+        if _is_jit_ref(node.func, al) and node.args:
             target = node.args[0]
             if isinstance(target, ast.Name):
                 target = local_defs.get(target.id)
@@ -530,7 +550,8 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     except SyntaxError as e:
         return [Finding("DLT000", path, e.lineno or 0, 0,
                         f"syntax error: {e.msg}")]
-    al = _Aliases(tree)
+    idx = _Index(tree)
+    al = _Aliases(idx.nodes)
     lines = src.splitlines()
 
     def allowed(rule: str, line: int) -> bool:
@@ -556,7 +577,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
         findings.append(Finding(rule, path, line, col, msg))
 
     for rule_pass in _PASSES:
-        rule_pass(tree, al, path, add)
+        rule_pass(idx, al, path, add)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 

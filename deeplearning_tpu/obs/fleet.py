@@ -156,6 +156,17 @@ def scrape_replica(url: str, timeout_s: float = 2.0) -> Dict[str, Any]:
         return out
     out["ok"] = True
     out["metrics"] = _flat(samples)
+    # per-tenant series: the zoo serve adapter labels its serve counters
+    # with model="<alias>"; keep them grouped so the rollup can fold
+    # per-model signals across replicas (the unlabeled sums above stay
+    # the fleet-wide view)
+    by_model: Dict[str, Dict[str, float]] = {}
+    for name, labels, value in samples:
+        model = labels.get("model")
+        if model:
+            by_model.setdefault(model, {})[name] = value
+    if by_model:
+        out["by_model"] = by_model
     for name, labels, _ in samples:
         if name == "dltpu_replica_info":
             out.update({k: v for k, v in labels.items()
@@ -194,6 +205,37 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
         completed_total += m.get(_COMPLETED_TOTAL, 0.0)
         if _E2E_P99 in m:
             p99s.append(m[_E2E_P99])
+    # fold per-tenant series across replicas (zoo serving: every serve
+    # counter carries a model label next to the fleet-wide sum)
+    model_acc: Dict[str, Dict[str, Any]] = {}
+    for s in samples:
+        for model, m in (s.get("by_model") or {}).items():
+            acc = model_acc.setdefault(model, {
+                "qps_total": 0.0, "rejects_per_s_total": 0.0,
+                "queue_depth_total": 0.0, "requests_total": 0.0,
+                "rejected_total": 0.0, "timed_out_total": 0.0,
+                "completed_total": 0.0, "_p99s": []})
+            acc["qps_total"] += m.get(_QPS, 0.0)
+            acc["rejects_per_s_total"] += m.get(_REJECTS_PER_S, 0.0)
+            acc["queue_depth_total"] += m.get(_QUEUE_DEPTH, 0.0)
+            acc["requests_total"] += m.get(_REQUESTS_TOTAL, 0.0)
+            acc["rejected_total"] += m.get(_REJECTED_TOTAL, 0.0)
+            acc["timed_out_total"] += m.get(_TIMED_OUT_TOTAL, 0.0)
+            acc["completed_total"] += m.get(_COMPLETED_TOTAL, 0.0)
+            if _E2E_P99 in m:
+                acc["_p99s"].append(m[_E2E_P99])
+    models: Dict[str, Dict[str, Any]] = {}
+    for model, acc in model_acc.items():
+        p99s_m = acc.pop("_p99s")
+        acc["e2e_ms_p99_max"] = round(max(p99s_m), 3) if p99s_m else 0.0
+        errs = acc["rejected_total"] + acc["timed_out_total"]
+        acc["error_rate"] = round(
+            errs / max(acc["requests_total"] + acc["rejected_total"],
+                       1.0), 5)
+        if slo is not None:
+            acc["slo"] = slo.evaluate(acc)
+        models[model] = acc
+
     errors = rejected_total + timed_out_total
     error_rate = errors / max(requests_total + rejected_total, 1.0)
     rollup: Dict[str, Any] = {
@@ -212,6 +254,8 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
         "timed_out_total": timed_out_total,
         "error_rate": round(error_rate, 5),
     }
+    if models:
+        rollup["models"] = models
     if slo is not None:
         rollup["slo"] = slo.evaluate(rollup)
     return rollup
@@ -303,6 +347,7 @@ class FleetScraper:
         self.interval_s = max(float(interval_s), 0.05)
         self.polls = 0
         self.breaches = 0
+        self.model_breaches = 0
         self.last_rollup: Optional[Dict[str, Any]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -332,6 +377,21 @@ class FleetScraper:
                         error_rate_budget=verdict["error_rate_budget"],
                         qps_total=rollup["qps_total"],
                         replicas=rollup["replicas"])
+        # per-tenant breaches: one event per breaching model so the
+        # controller can act on the hot tenant, not the whole fleet
+        for model, row in sorted((rollup.get("models") or {}).items()):
+            mv = row.get("slo") or {}
+            if mv.get("breach"):
+                self.model_breaches += 1
+                _flight_record(
+                    "slo_breach", model=model,
+                    signal=("p99" if mv.get("p99_breach")
+                            else "error_rate"),
+                    p99_ms=mv["p99_ms"],
+                    p99_budget_ms=mv["p99_budget_ms"],
+                    error_rate=mv["error_rate"],
+                    error_rate_budget=mv["error_rate_budget"],
+                    qps_total=row.get("qps_total", 0.0))
         if self.fleet_path:
             self._append(rollup)
         return rollup

@@ -244,29 +244,40 @@ class MetricsRegistry:
         self.created = time.time()
 
     # ------------------------------------------------------ get-or-create
-    def _get(self, name: str, factory: Callable[[], Any], kind: str):
-        metric = self._metrics.get(name)         # GIL-safe fast path
+    def _get(self, name: str, factory: Callable[[], Any], kind: str,
+             labels: Optional[Dict[str, str]] = None):
+        # a labeled series is its own metric object keyed by
+        # name+labelset (the prometheus data model: one timeseries per
+        # distinct label combination under a shared metric name)
+        key = name if not labels else name + _fmt_labels(labels)
+        metric = self._metrics.get(key)          # GIL-safe fast path
         if metric is None:
             with self._lock:
-                metric = self._metrics.get(name)
+                metric = self._metrics.get(key)
                 if metric is None:
                     metric = factory()
-                    self._metrics[name] = metric
+                    self._metrics[key] = metric
         if metric.kind != kind:
             raise TypeError(f"metric {name!r} is a {metric.kind}, "
                             f"not a {kind}")
         return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, lambda: Counter(name, help), "counter")
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(name, lambda: Counter(name, help, labels),
+                         "counter", labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, lambda: Gauge(name, help), "gauge")
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(name, lambda: Gauge(name, help, labels),
+                         "gauge", labels)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help, buckets),
-                         "histogram")
+                  buckets: Optional[Sequence[float]] = None,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get(name,
+                         lambda: Histogram(name, help, buckets, labels),
+                         "histogram", labels)
 
     # --------------------------------------------------------- collectors
     def register_collector(
@@ -305,24 +316,35 @@ class MetricsRegistry:
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4 (# HELP / # TYPE +
-        sample lines; histograms as cumulative _bucket/_sum/_count)."""
+        sample lines; histograms as cumulative _bucket/_sum/_count).
+        Labeled series of one name are grouped under a single
+        HELP/TYPE header, per the format's one-family-per-name rule."""
         self.collect()
-        lines: List[str] = []
+        by_name: Dict[str, List[Any]] = {}
         for m in self._all_metrics():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m._expose())
+            by_name.setdefault(m.name, []).append(m)
+        lines: List[str] = []
+        for name, family in by_name.items():
+            head = family[0]
+            help_text = next((m.help for m in family if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {head.kind}")
+            for m in family:
+                lines.extend(m._expose())
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON view of the same state the text format exposes, plus
-        identity — what ``obs/fleet.py`` and ``obs_report`` consume."""
+        identity — what ``obs/fleet.py`` and ``obs_report`` consume.
+        Unlabeled metrics keep their bare name as the key; labeled
+        series are keyed ``name{label="value"}``."""
         self.collect()
         doc: Dict[str, Any] = {"time": time.time(),
                                **replica_identity(),
                                "collect_errors": self.collect_errors}
-        doc["metrics"] = {m.name: m._sample() for m in self._all_metrics()}
+        doc["metrics"] = {m.name + _fmt_labels(m.labels): m._sample()
+                          for m in self._all_metrics()}
         return doc
 
     def dump(self, path: str) -> str:
@@ -363,28 +385,31 @@ def enabled() -> bool:
 
 
 # ------------------------------------------------------- hot-path helpers
-def inc(name: str, n: float = 1.0) -> None:
+def inc(name: str, n: float = 1.0,
+        labels: Optional[Dict[str, str]] = None) -> None:
     """Counter bump; a no-op costing one ``is None`` check when the
     registry is disabled (hot-path safe by the spans discipline)."""
     reg = _REGISTRY
     if reg is None:
         return
-    reg.counter(name).inc(n)
+    reg.counter(name, labels=labels).inc(n)
 
 
-def set_gauge(name: str, value: float) -> None:
+def set_gauge(name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
     reg = _REGISTRY
     if reg is None:
         return
-    reg.gauge(name).set(value)
+    reg.gauge(name, labels=labels).set(value)
 
 
 def observe(name: str, value: float,
-            buckets: Optional[Sequence[float]] = None) -> None:
+            buckets: Optional[Sequence[float]] = None,
+            labels: Optional[Dict[str, str]] = None) -> None:
     reg = _REGISTRY
     if reg is None:
         return
-    reg.histogram(name, buckets=buckets).observe(value)
+    reg.histogram(name, buckets=buckets, labels=labels).observe(value)
 
 
 # --------------------------------------------------------- endpoint files

@@ -15,15 +15,28 @@ telemetry. CLIs: ``tools/serve.py`` (server), ``tools/loadgen.py``
         handle = mb.submit(image)          # (96, 96, 3) model-ready
         probs = handle.result(timeout=1.0)
 
-See README "Serving policy" for the bucket table and overload rules.
+Multi-tenant: a ``ModelZoo`` fronts N models in one process (hot
+load/evict under HBM pressure, per-tenant quotas, optional int8 weight
+residency):
+
+    zoo = serve.ModelZoo()
+    zoo.register("digits", "mnist_fcn", num_classes=10, image_size=28)
+    with serve.MicroBatcher(zoo=zoo) as mb:
+        probs = mb.submit(image, model="digits").result(timeout=30.0)
+
+See README "Serving policy" / "Multi-tenant serving policy" for the
+bucket table, overload rules, and the load/evict lifecycle.
 """
 
-from .admission import AdmissionController, DeadlineExceeded, Rejected
+from .admission import (AdmissionController, DeadlineExceeded, Rejected,
+                        TenantAdmission)
 from .batcher import MicroBatcher, SubmitHandle
 from .engine import InferenceEngine
-from .health import health
+from .health import health, zoo_health
 from .telemetry import ServeTelemetry
+from .zoo import ModelSpec, ModelZoo
 
 __all__ = ["InferenceEngine", "MicroBatcher", "SubmitHandle",
-           "AdmissionController", "Rejected", "DeadlineExceeded",
-           "ServeTelemetry", "health"]
+           "AdmissionController", "TenantAdmission", "Rejected",
+           "DeadlineExceeded", "ServeTelemetry", "health", "zoo_health",
+           "ModelZoo", "ModelSpec"]

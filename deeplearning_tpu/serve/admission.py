@@ -21,20 +21,32 @@ explicit and testable, decoupled from the batcher mechanics:
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
-__all__ = ["AdmissionController", "Rejected", "DeadlineExceeded"]
+__all__ = ["AdmissionController", "TenantAdmission", "Rejected",
+           "DeadlineExceeded"]
 
 
 class Rejected(Exception):
-    """Queue-full backpressure: retry after ``retry_after_s`` seconds."""
+    """Queue-full backpressure: retry after ``retry_after_s`` seconds.
 
-    def __init__(self, depth: int, retry_after_s: float):
+    ``model`` names the tenant whose queue rejected the request (None in
+    single-model serving); ``reason`` distinguishes a full per-model
+    queue (``"queue_full"``) from zoo capacity pressure with nothing
+    evictable (``"hbm_pressure"``). Both surface in the 429 body."""
+
+    def __init__(self, depth: int, retry_after_s: float,
+                 model: Optional[str] = None,
+                 reason: str = "queue_full"):
         self.depth = depth
         self.retry_after_s = retry_after_s
+        self.model = model
+        self.reason = reason
+        who = f"model {model!r} " if model else ""
         super().__init__(
-            f"serve queue full ({depth} pending); "
+            f"serve {who}{reason.replace('_', ' ')} ({depth} pending); "
             f"retry after {retry_after_s:.3f}s")
 
 
@@ -58,7 +70,8 @@ class AdmissionController:
 
     def __init__(self, buckets: Sequence[int], *, max_queue: int = 256,
                  shed_threshold: Optional[int] = None,
-                 default_timeout_s: Optional[float] = None):
+                 default_timeout_s: Optional[float] = None,
+                 model: Optional[str] = None):
         self.buckets = tuple(sorted(int(b) for b in buckets))
         if not self.buckets:
             raise ValueError("admission needs at least one batch bucket")
@@ -66,15 +79,20 @@ class AdmissionController:
         self.shed_threshold = (int(shed_threshold) if shed_threshold
                                is not None else self.buckets[-1])
         self.default_timeout_s = default_timeout_s
+        self.model = model
         # drain-rate estimate for retry_after hints (EWMA of req/s seen
-        # at each dispatch; updated by the batcher)
+        # at each dispatch; updated by the batcher). Per-controller
+        # state: in multi-tenant serving every model owns one controller
+        # (see TenantAdmission), so a 429's retry_after always quotes
+        # the TARGET model's drain — never a hotter neighbor's.
         self._drain_rate = 0.0
 
     # ----------------------------------------------------- backpressure
     def admit(self, queue_depth: int) -> None:
         """Raise ``Rejected`` when the queue cannot take one more."""
         if queue_depth >= self.max_queue:
-            raise Rejected(queue_depth, self.retry_after_s(queue_depth))
+            raise Rejected(queue_depth, self.retry_after_s(queue_depth),
+                           model=self.model)
 
     def retry_after_s(self, queue_depth: int) -> float:
         """Time until the backlog plausibly has room: depth over the
@@ -127,3 +145,58 @@ class AdmissionController:
             if b >= want:
                 return b
         return self.buckets[-1]
+
+
+class TenantAdmission:
+    """Per-tenant admission for multi-model serving: one
+    :class:`AdmissionController` per model, each with its own queue
+    quota, shed threshold, deadline default — and its own EWMA drain
+    rate, which is the bugfix over sharing one controller: a cold
+    tenant's ``Rejected.retry_after_s`` is computed from that tenant's
+    OWN drain history, not from whichever hot neighbor last dispatched.
+
+    ``configure`` registers a model's policy (the zoo does this at
+    ``register`` time); ``for_model`` is the per-request lookup, falling
+    back to a default-policy controller for unconfigured models so bare
+    batcher usage keeps working."""
+
+    def __init__(self, *, default_buckets: Sequence[int] = (1, 8, 32, 128),
+                 default_max_queue: int = 256,
+                 default_timeout_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._controllers: Dict[str, AdmissionController] = {}
+        self.default_buckets = tuple(sorted(int(b)
+                                            for b in default_buckets))
+        self.default_max_queue = int(default_max_queue)
+        self.default_timeout_s = default_timeout_s
+
+    def configure(self, model: str, buckets: Sequence[int], *,
+                  max_queue: Optional[int] = None,
+                  shed_threshold: Optional[int] = None,
+                  default_timeout_s: Optional[float] = None
+                  ) -> AdmissionController:
+        ctrl = AdmissionController(
+            buckets,
+            max_queue=(max_queue if max_queue is not None
+                       else self.default_max_queue),
+            shed_threshold=shed_threshold,
+            default_timeout_s=(default_timeout_s
+                               if default_timeout_s is not None
+                               else self.default_timeout_s),
+            model=model)
+        with self._lock:
+            self._controllers[model] = ctrl
+        return ctrl
+
+    def for_model(self, model: str) -> AdmissionController:
+        ctrl = self._controllers.get(model)      # GIL-safe fast path
+        if ctrl is None:
+            with self._lock:
+                ctrl = self._controllers.get(model)
+            if ctrl is None:
+                ctrl = self.configure(model, self.default_buckets)
+        return ctrl
+
+    def models(self) -> Dict[str, AdmissionController]:
+        with self._lock:
+            return dict(self._controllers)

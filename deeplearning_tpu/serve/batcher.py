@@ -4,19 +4,29 @@ The throughput case for serving on a TPU is the same as for training:
 the chip wants large static batches, clients send batch-1 requests. The
 ``MicroBatcher`` closes the gap with the ``DevicePrefetcher`` worker
 discipline — one dedicated dispatch thread owns the device, everything
-else talks to it through a queue:
+else talks to it through per-model queues ("lanes"):
 
-1. ``submit()`` runs admission control (backpressure/deadline stamping),
-   enqueues a request, and returns a ``SubmitHandle`` future.
-2. The dispatch thread pops the first waiting request, then accumulates
-   followers until the admission policy's target bucket is full or
-   ``max_wait_ms`` expires — light traffic dispatches immediately in the
-   smallest bucket, bursts fill big buckets.
-3. The batch is padded to its bucket, run through the engine's AOT
+1. ``submit()`` runs admission control (backpressure/deadline stamping)
+   against the TARGET model's lane, enqueues, and returns a
+   ``SubmitHandle`` future.
+2. The dispatch thread round-robins over lanes with waiting work (so
+   one hot tenant cannot starve the rest), pops the first request, then
+   accumulates same-model followers until the lane's bucket family is
+   full or ``max_wait_ms`` expires — light traffic dispatches
+   immediately in the smallest bucket, bursts fill big buckets.
+3. The batch is padded to its bucket, run through that model's AOT
    executable (never a compile), and demultiplexed: each request's
    future resolves to ITS row of the device outputs. Padding rows are
    sliced away here and never observable (detection padding additionally
    carries class −1 inside each row's fixed-shape slots, PR 3).
+
+Two fronting modes share all of the above: ``MicroBatcher(engine)``
+serves one model through one implicit lane (the PR 4 surface,
+unchanged), while ``MicroBatcher(zoo=...)`` serves every model a
+:class:`~.zoo.ModelZoo` holds — ``submit(image, model=alias)`` routes
+to the tenant's lane, cold tenants get a background hot-load kicked
+and their lane skipped until the zoo's warm flag flips, and each lane
+owns its telemetry + admission controller (per-model EWMA drain).
 
 The dispatch thread never materializes device values — demux is an
 async row-slice, latency bookkeeping is host timestamps — so a slow
@@ -26,12 +36,12 @@ syncs happen on the thread that wants the number).
 
 from __future__ import annotations
 
+import collections
 import itertools
-import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -53,6 +63,21 @@ class _Request:
         self.future = future
         self.deadline = deadline
         self.t_submit = t_submit
+
+
+class _Lane:
+    """One model's wait queue + policy + counters. The deque is guarded
+    by the batcher's condition variable; admission/telemetry objects are
+    internally locked."""
+
+    __slots__ = ("model", "q", "admission", "telemetry")
+
+    def __init__(self, model: str, admission: AdmissionController,
+                 telemetry: ServeTelemetry):
+        self.model = model
+        self.q: "collections.deque[_Request]" = collections.deque()
+        self.admission = admission
+        self.telemetry = telemetry
 
 
 class _SharedBatch:
@@ -81,14 +106,19 @@ class SubmitHandle:
     """Per-request future. ``result()`` blocks for the demuxed row and
     materializes it on the CALLING thread (the D2H lands on the
     requester, keeping the dispatcher sync-free), recording e2e latency
-    into telemetry exactly once."""
+    into telemetry exactly once (into the lane's AND the aggregate
+    rings in zoo mode)."""
 
     def __init__(self, rid: int, future: Future, t_submit: float,
-                 telemetry: Optional[ServeTelemetry]):
+                 telemetry: Any):
         self.rid = rid
         self._future = future
         self._t_submit = t_submit
-        self._telemetry = telemetry
+        if telemetry is None:
+            telemetry = ()
+        elif isinstance(telemetry, ServeTelemetry):
+            telemetry = (telemetry,)
+        self._telemetry = tuple(telemetry)
         self._recorded = False
 
     def done(self) -> bool:
@@ -97,10 +127,11 @@ class SubmitHandle:
     def result(self, timeout: Optional[float] = None) -> Any:
         shared, i = self._future.result(timeout)
         out = shared.row(i)
-        if not self._recorded and self._telemetry is not None:
+        if not self._recorded and self._telemetry:
             self._recorded = True
-            self._telemetry.record_e2e_latency(
-                time.perf_counter() - self._t_submit)
+            e2e = time.perf_counter() - self._t_submit
+            for t in self._telemetry:
+                t.record_e2e_latency(e2e)
         return out
 
     def exception(self, timeout: Optional[float] = None):
@@ -108,37 +139,56 @@ class SubmitHandle:
 
 
 class MicroBatcher:
-    """Dynamic micro-batching front of an ``InferenceEngine``.
+    """Dynamic micro-batching front of one ``InferenceEngine`` or a
+    whole ``ModelZoo``.
 
     - ``max_wait_ms``: how long the dispatcher holds an underfull batch
       open for followers before padding and going (the latency the
       lightest-traffic request pays for batching).
-    - ``admission``: an ``AdmissionController``; defaults to one sized
-      on the engine's buckets with ``max_queue`` pending requests.
+    - ``admission``: an ``AdmissionController``; single-engine mode
+      defaults to one sized on the engine's buckets with ``max_queue``
+      pending requests. Zoo mode ignores it — each tenant's controller
+      comes from ``zoo.admission_for``.
     - Runs its dispatch thread from construction; ``close()`` (or the
       context manager) drains and stops it.
     """
 
-    def __init__(self, engine, *, max_wait_ms: float = 5.0,
+    def __init__(self, engine=None, *, zoo=None,
+                 max_wait_ms: float = 5.0,
                  max_queue: int = 256,
                  default_timeout_s: Optional[float] = None,
                  admission: Optional[AdmissionController] = None,
                  telemetry: Optional[ServeTelemetry] = None,
                  heartbeat=None,
                  start: bool = True):
+        if (engine is None) == (zoo is None):
+            raise ValueError("pass exactly one of engine= or zoo=")
         self.engine = engine
+        self.zoo = zoo
         self.max_wait_s = max_wait_ms / 1e3
-        self.admission = admission or AdmissionController(
-            engine.buckets, max_queue=max_queue,
-            default_timeout_s=default_timeout_s)
         self.telemetry = telemetry or ServeTelemetry()
+        self._cv = threading.Condition()
+        self._lanes: Dict[str, _Lane] = {}
+        self._rr = 0                   # round-robin cursor over lanes
+        if engine is not None:
+            self.admission = admission or AdmissionController(
+                engine.buckets, max_queue=max_queue,
+                default_timeout_s=default_timeout_s)
+            # the single-engine surface is one implicit lane sharing the
+            # aggregate telemetry (so nothing records twice)
+            self._default_lane = _Lane(
+                getattr(engine, "name", "model"), self.admission,
+                self.telemetry)
+            self._lanes[self._default_lane.model] = self._default_lane
+        else:
+            self.admission = None
+            self._default_lane = None
         # elastic surface: an elastic.heartbeat.Heartbeat whose activity
         # watermark advances once per dispatched batch — the same
         # liveness contract the Trainer gives its supervisor
         self._beat = heartbeat
         self.dispatched = 0            # batches the dispatch loop finished
         self._busy = False             # dispatch thread is inside a batch
-        self._q: "queue.Queue[_Request]" = queue.Queue()
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -156,6 +206,8 @@ class MicroBatcher:
 
     def close(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
 
@@ -167,7 +219,17 @@ class MicroBatcher:
 
     @property
     def queue_depth(self) -> int:
-        return self._q.qsize()
+        with self._cv:
+            return sum(len(lane.q) for lane in self._lanes.values())
+
+    def lane_depth(self, model: str) -> int:
+        with self._cv:
+            lane = self._lanes.get(model)
+            return len(lane.q) if lane is not None else 0
+
+    def lane_telemetry(self, model: str) -> Optional[ServeTelemetry]:
+        lane = self._lanes.get(model)
+        return lane.telemetry if lane is not None else None
 
     @property
     def busy(self) -> bool:
@@ -176,78 +238,182 @@ class MicroBatcher:
         in-flight batch idle."""
         return self._busy
 
+    # -------------------------------------------------------- lanes
+    def _lane(self, model: Optional[str]) -> _Lane:
+        if self._default_lane is not None:
+            return self._default_lane
+        if model is None:
+            models = self.zoo.models()
+            if len(models) != 1:
+                raise ValueError(
+                    f"zoo serves {models}; submit(model=...) required")
+            model = models[0]
+        lane = self._lanes.get(model)
+        if lane is None:
+            admission = self.zoo.admission_for(model)  # raises KeyError
+            with self._cv:
+                lane = self._lanes.get(model)
+                if lane is None:
+                    lane = _Lane(model, admission, ServeTelemetry())
+                    self._lanes[model] = lane
+        return lane
+
+    def _tels(self, lane: _Lane) -> Tuple[ServeTelemetry, ...]:
+        if lane.telemetry is self.telemetry:
+            return (lane.telemetry,)
+        return (lane.telemetry, self.telemetry)
+
+    def _engine_for(self, lane: _Lane):
+        """The lane's warm engine, or None (zoo lane still loading — the
+        load was kicked at submit; the dispatcher just skips the lane)."""
+        if self.engine is not None:
+            return self.engine
+        return self.zoo.engine(lane.model)
+
     # ----------------------------------------------------------- submit
-    def submit(self, image, timeout_s: Optional[float] = None
-               ) -> SubmitHandle:
-        """Admit one request. Raises ``serve.Rejected`` on a full queue
-        (backpressure, with a retry-after hint); the returned handle's
-        ``result()`` raises ``DeadlineExceeded`` if the request expired
-        before dispatch. ``image`` must be one model-ready
-        (image_size, image_size, 3) frame — resizing/normalizing is the
-        client's job (tools/serve.py does it for files)."""
-        size = self.engine.image_size
+    def submit(self, image, timeout_s: Optional[float] = None,
+               model: Optional[str] = None) -> SubmitHandle:
+        """Admit one request. Raises ``serve.Rejected`` on a full lane
+        (backpressure, with the TARGET model's retry-after hint) or —
+        zoo mode — when the model would need a load that HBM pressure
+        refuses; the returned handle's ``result()`` raises
+        ``DeadlineExceeded`` if the request expired before dispatch.
+        ``image`` must be one model-ready (image_size, image_size, 3)
+        frame — resizing/normalizing is the client's job
+        (tools/serve.py does it for files)."""
+        lane = self._lane(model)
+        if self.engine is not None:
+            size = self.engine.image_size
+        else:
+            size = self.zoo.image_size(lane.model)
         image = np.asarray(image, np.float32)  # dltpu: allow(DLT100) host input
         if image.shape != (size, size, 3):
             raise ValueError(f"request image shape {image.shape} != "
                              f"({size}, {size}, 3); resize client-side")
         try:
-            self.admission.admit(self._q.qsize())
+            if self.zoo is not None:
+                # warm fast-path: dict lookup. Cold: kicks a background
+                # hot-load (may LRU-evict; raises Rejected on pressure)
+                self.zoo.request(lane.model)
+            lane.admission.admit(len(lane.q))
         except Exception:
-            self.telemetry.record_reject()
-            flight.record("serve_reject", depth=self._q.qsize())
+            for t in self._tels(lane):
+                t.record_reject()
+            flight.record("serve_reject", model=lane.model,
+                          depth=len(lane.q))
             raise
         now = time.perf_counter()
         req = _Request(next(self._ids), image, Future(),
-                       self.admission.deadline_for(timeout_s, now), now)
-        self.telemetry.record_submit()
-        self._q.put(req)
-        return SubmitHandle(req.rid, req.future, now, self.telemetry)
+                       lane.admission.deadline_for(timeout_s, now), now)
+        for t in self._tels(lane):
+            t.record_submit()
+        with self._cv:
+            lane.q.append(req)
+            self._cv.notify_all()
+        return SubmitHandle(req.rid, req.future, now, self._tels(lane))
 
     # --------------------------------------------------------- dispatch
-    def _expire(self, req: _Request, now: float) -> bool:
+    def _expire(self, lane: _Lane, req: _Request, now: float) -> bool:
         """Cancel a request whose deadline passed BEFORE spending device
         time on it; True when the request was dropped."""
-        if self.admission.expired(req.deadline, now):
+        if lane.admission.expired(req.deadline, now):
             req.future.set_exception(DeadlineExceeded(
                 f"request {req.rid} expired after "
                 f"{now - req.t_submit:.3f}s in queue"))
-            self.telemetry.record_timeout()
+            for t in self._tels(lane):
+                t.record_timeout()
             return True
         return False
 
-    def _collect(self) -> list:
-        """Block for one request, then hold the batch open for followers
-        until the LARGEST bucket fills or ``max_wait_ms`` expires — a
-        burst rides one big executable, a lone request pays at most
-        ``max_wait_ms`` extra latency before going out in bucket 1."""
-        try:
-            first = self._q.get(timeout=0.05)
-        except queue.Empty:
-            return []
+    def _purge_expired(self, lane: _Lane) -> None:
+        """Deadline enforcement for a lane whose engine is still
+        warming: expired requests fail now, not after the load."""
+        now = time.perf_counter()
+        with self._cv:
+            keep = collections.deque()
+            for req in lane.q:
+                if not self._expire(lane, req, now):
+                    keep.append(req)
+            lane.q = keep
+
+    def _pick_lane(self) -> Optional[Tuple[_Lane, Any]]:
+        """Wait (≤50ms) for any lane with work, then round-robin to the
+        next one whose engine is ready. Lanes of still-loading models
+        are skipped (their hot-load is already running); round-robin
+        across ready lanes is the anti-starvation guarantee — a
+        saturated tenant gets one batch per turn, not the whole
+        thread."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._stop.is_set()
+                or any(lane.q for lane in self._lanes.values()),
+                timeout=0.05)
+            if self._stop.is_set():
+                return None
+            names: List[str] = [name for name, lane
+                                in self._lanes.items() if lane.q]
+        if not names:
+            return None
+        order = sorted(names)
+        start = self._rr % len(order)
+        cold = []
+        for name in order[start:] + order[:start]:
+            lane = self._lanes[name]
+            engine = self._engine_for(lane)
+            if engine is None:
+                cold.append(lane)
+                continue
+            if lane.q:
+                self._rr += 1
+                return lane, engine
+        for lane in cold:
+            self._purge_expired(lane)
+        if cold:
+            # every pending lane is warming: don't spin on the CV (the
+            # warm flag flips without a notify) — nap one poll tick
+            self._stop.wait(0.01)
+        return None
+
+    def _collect(self, lane: _Lane, engine) -> list:
+        """Pop one request from the lane, then hold the batch open for
+        same-model followers until the LARGEST bucket fills or
+        ``max_wait_ms`` expires — a burst rides one big executable, a
+        lone request pays at most ``max_wait_ms`` extra latency before
+        going out in bucket 1."""
+        with self._cv:
+            if not lane.q:
+                return []
+            first = lane.q.popleft()
         t0 = time.perf_counter()
-        batch = [] if self._expire(first, t0) else [first]
+        batch = [] if self._expire(lane, first, t0) else [first]
         wait_until = t0 + self.max_wait_s
-        big = self.engine.buckets[-1]
+        big = engine.buckets[-1]
         while len(batch) < big:
             remaining = wait_until - time.perf_counter()
             if remaining <= 0:
                 break
-            try:
-                req = self._q.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if not self._expire(req, time.perf_counter()):
+            with self._cv:
+                if not lane.q:
+                    self._cv.wait(timeout=remaining)
+                if not lane.q:
+                    continue            # spurious/other-lane wakeup
+                req = lane.q.popleft()
+            if not self._expire(lane, req, time.perf_counter()):
                 batch.append(req)
         return batch
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
-            batch = self._collect()
+            picked = self._pick_lane()
+            if picked is None:
+                continue
+            lane, engine = picked
+            batch = self._collect(lane, engine)
             if not batch:
                 continue
             self._busy = True
             try:
-                self._dispatch_one(batch)
+                self._dispatch_one(lane, engine, batch)
             finally:
                 # count the batch whether it ran or errored — both mean
                 # the dispatch thread is ALIVE (what a wedge probe asks)
@@ -256,30 +422,39 @@ class MicroBatcher:
                 if self._beat is not None:
                     self._beat.touch("dispatch", step=self.dispatched)
 
-    def _dispatch_one(self, batch: list) -> None:
+    def _dispatch_one(self, lane: _Lane, engine, batch: list) -> None:
         t0 = time.perf_counter()
-        depth = self._q.qsize()
-        shed = self.admission.overloaded(depth)
-        bucket = (self.engine.buckets[-1] if shed
-                  else self.engine.bucket_for(len(batch)))
+        depth = len(lane.q)
+        shed = lane.admission.overloaded(depth)
+        bucket = (engine.buckets[-1] if shed
+                  else engine.bucket_for(len(batch)))
+        if self.zoo is not None:
+            self.zoo.mark_dispatch(lane.model, +1)
         try:
-            with span("serve/dispatch", bucket=bucket, n=len(batch),
-                      depth=depth, shed=shed):
-                padded = self.engine.pad_to_bucket(
+            with span("serve/dispatch", model=lane.model, bucket=bucket,
+                      n=len(batch), depth=depth, shed=shed):
+                padded = engine.pad_to_bucket(
                     np.stack([r.image for r in batch]), bucket)
-                out = self.engine.run(bucket, padded)
+                out = engine.run(bucket, padded)
         except BaseException as exc:  # noqa: BLE001 - to the futures
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(exc)
             return
+        finally:
+            if self.zoo is not None:
+                self.zoo.mark_dispatch(lane.model, -1)
         now = time.perf_counter()
         shared = _SharedBatch(out)
+        tels = self._tels(lane)
         for i, r in enumerate(batch):
             # hand each request its row of the shared device batch —
             # no sync here; the first result() call materializes once
             r.future.set_result((shared, i))
-            self.telemetry.record_dispatch_latency(now - r.t_submit)
-        self.telemetry.record_batch(bucket, len(batch),
-                                    self._q.qsize(), shed)
-        self.admission.note_drained(len(batch), now - t0)
+            for t in tels:
+                t.record_dispatch_latency(now - r.t_submit)
+        for t in tels:
+            t.record_batch(bucket, len(batch), len(lane.q), shed)
+        # per-model EWMA: the drain estimate behind retry_after quotes
+        # THIS tenant's dispatch history (the TenantAdmission bugfix)
+        lane.admission.note_drained(len(batch), now - t0)

@@ -37,6 +37,54 @@ from ..obs.xla import tracked_compile
 
 __all__ = ["InferenceEngine"]
 
+# int8 weight residency (the EQuARX block-scaled machinery from
+# parallel/collectives.py, applied to resident weights instead of
+# gradient wires): each 256-element block shares one power-of-two fp32
+# scale, so a resident leaf costs ~1 byte/elem + 4/256 scale overhead —
+# ~3.9x denser than fp32. Leaves below _QUANT_MIN_SIZE stay fp32
+# (biases, norm scales: quantizing them buys nothing and costs
+# accuracy).
+_QUANT_BLOCK = 256
+_QUANT_MIN_SIZE = 1024
+
+
+def _quantize_variables(variables):
+    """variables pytree -> (quantized leaves list, meta list, treedef).
+    Large float leaves become {"q": int8, "s": fp32 scales} pairs; the
+    meta entry carries (shape, dtype, size) to invert the flatten+pad."""
+    from ..parallel.collectives import _pad_to, _quantize_blocks
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    qleaves, meta = [], []
+    for x in leaves:
+        arr = jnp.asarray(x)
+        if (jnp.issubdtype(arr.dtype, jnp.floating)
+                and arr.size >= _QUANT_MIN_SIZE):
+            flat, _ = _pad_to(arr.astype(jnp.float32).reshape(-1),
+                              _QUANT_BLOCK)
+            q, s = _quantize_blocks(flat.reshape(-1, _QUANT_BLOCK))
+            qleaves.append({"q": q, "s": s})
+            meta.append((arr.shape, arr.dtype, arr.size))
+        else:
+            qleaves.append(arr)
+            meta.append(None)
+    return qleaves, meta, treedef
+
+
+def _dequantize_variables(qleaves, meta, treedef):
+    """Inverse of ``_quantize_variables``; runs INSIDE the traced
+    forward, so dequantization is part of each bucket's executable and
+    HBM holds only the int8 payloads between requests."""
+    from ..parallel.collectives import _dequantize_blocks
+    out = []
+    for leaf, m in zip(qleaves, meta):
+        if m is None:
+            out.append(leaf)
+        else:
+            shape, dtype, size = m
+            x = _dequantize_blocks(leaf["q"], leaf["s"]).reshape(-1)
+            out.append(x[:size].reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
 
 class InferenceEngine:
     """A servable model session with per-bucket AOT executables.
@@ -65,7 +113,8 @@ class InferenceEngine:
                  post_nms_top_n: int = 256,
                  seed: int = 0,
                  precompile: bool = True,
-                 use_compile_cache: bool = True):
+                 use_compile_cache: bool = True,
+                 weight_quant: str = "fp32"):
         from ..models.detection.predict import is_detection_model
 
         if model is None and model_name is None:
@@ -113,7 +162,17 @@ class InferenceEngine:
             if ckpt:
                 from ..core.checkpoint import restore_variables
                 variables = restore_variables(ckpt, variables)
-        # the session's single resident copy of the weights
+        if weight_quant not in ("fp32", "int8"):
+            raise ValueError(f"weight_quant must be fp32 or int8, "
+                             f"got {weight_quant!r}")
+        self.weight_quant = weight_quant
+        self._quant_meta = None
+        self._quant_treedef = None
+        if weight_quant == "int8":
+            variables, self._quant_meta, self._quant_treedef = \
+                _quantize_variables(variables)
+        # the session's single resident copy of the weights (int8
+        # payloads + block scales when weight_quant="int8")
         self._variables = jax.device_put(variables)
 
         # counters: the "zero compiles after warmup" test surface
@@ -128,6 +187,19 @@ class InferenceEngine:
 
     # ------------------------------------------------------- forward fn
     def _make_forward(self) -> Callable:
+        inner = self._make_inner_forward()
+        if self.weight_quant != "int8":
+            return inner
+        meta, treedef = self._quant_meta, self._quant_treedef
+
+        def forward(qleaves, images):
+            # dequantize inside the trace: the executable reads int8
+            # payloads from HBM and reconstructs fp32 weights on the fly
+            return inner(_dequantize_variables(qleaves, meta, treedef),
+                         images)
+        return forward
+
+    def _make_inner_forward(self) -> Callable:
         model = self.model
         if self.task == "classify":
             if self.tta:
@@ -246,6 +318,13 @@ class InferenceEngine:
         return out
 
     # ------------------------------------------------------ introspection
+    def variables_nbytes(self) -> int:
+        """Resident weight bytes (host metadata read over the device
+        arrays — never a sync). With ``weight_quant="int8"`` this is the
+        quantized footprint, the number HBM actually pays."""
+        return int(sum(getattr(x, "nbytes", 0) for x in
+                       jax.tree_util.tree_leaves(self._variables)))
+
     def stats(self) -> Dict[str, Any]:
         return {
             "model": self.name,
@@ -255,6 +334,8 @@ class InferenceEngine:
             "trace_count": self.trace_count,
             "compile_count": self.compile_count,
             "warm": self.compile_count >= len(self.buckets),
+            "weight_quant": self.weight_quant,
+            "variables_bytes": self.variables_nbytes(),
             "warmup_seconds": {str(b): round(s, 4)
                                for b, s in self.warmup_seconds.items()},
         }

@@ -15,10 +15,16 @@ Modes:
 - ``compare``: sequential then closed, printing the speedup (the serve
   acceptance gate: batched ≥3× sequential at 64 clients on CPU).
 
+Mixed multi-tenant traffic (one ``ModelZoo``, weighted per-request
+model choice, per-model op rows):
+
+  python tools/loadgen.py --mode closed --concurrency 32 --n 256 \\
+      --mix "mnist_fcn=0.7,mnist_cnn=0.3" --size 28 --buckets 1,8,32
+
 Every run can append a ``--set serve`` row (op schema:
 ``bench_util.append_op_result``) to tools/mfu_results.jsonl so the
 request-path latency trajectory is recorded next to the train-step MFU
-rows.
+rows; ``--mix`` runs append one row per tenant.
 """
 
 from __future__ import annotations
@@ -71,17 +77,82 @@ def run_sequential(engine, images, n_requests: int) -> dict:
             "wall_s": round(wall, 3), **_percentiles_ms(lats)}
 
 
+def parse_mix(raw: str) -> dict:
+    """``--mix "a=0.7,b=0.3"`` → {alias: normalized weight}. A bare
+    alias counts as weight 1 before normalization."""
+    out = {}
+    for part in raw.split(","):
+        alias, _, w = part.partition("=")
+        alias = alias.strip()
+        if alias:
+            out[alias] = float(w) if w else 1.0
+    if not out:
+        raise ValueError(f"empty --mix {raw!r}")
+    total = sum(out.values())
+    return {a: w / total for a, w in out.items()}
+
+
+class _MixSampler:
+    """Weighted per-request model choice + per-model tallies for the
+    mixed-traffic loops. ``None`` mix degrades to the single-model path
+    (model=None submits, one aggregate tally)."""
+
+    def __init__(self, mix, images_by_model, images):
+        self.mix = mix
+        self.aliases = sorted(mix) if mix else [None]
+        self.weights = (np.asarray([mix[a] for a in self.aliases])
+                        if mix else None)
+        self.images_by_model = images_by_model or {}
+        self.images = images
+        self.per = {a: {"completed": 0, "rejected": 0, "timed_out": 0,
+                        "lats": []} for a in self.aliases}
+
+    def pick(self, rng):
+        if self.mix is None:
+            return None, self.images
+        alias = self.aliases[int(rng.choice(len(self.aliases),
+                                            p=self.weights))]
+        return alias, self.images_by_model.get(alias, self.images)
+
+    def tally(self, alias, key, lat=None):
+        row = self.per[alias]
+        row[key] += 1
+        if lat is not None:
+            row["lats"].append(lat)
+
+    def model_recs(self, mode: str, wall: float) -> dict:
+        if self.mix is None:
+            return {}
+        out = {}
+        for alias in self.aliases:
+            row = self.per[alias]
+            out[alias] = {
+                "mode": mode, "model": alias,
+                "mix_weight": round(self.mix[alias], 4),
+                "completed": row["completed"],
+                "rejected": row["rejected"],
+                "timed_out": row["timed_out"],
+                "req_per_s": round(row["completed"] / max(wall, 1e-9), 1),
+                **_percentiles_ms(row["lats"])}
+        return out
+
+
 def run_closed_loop(batcher, images, concurrency: int, n_requests: int,
-                    timeout_s: float = 30.0) -> dict:
+                    timeout_s: float = 30.0, mix=None,
+                    images_by_model=None) -> dict:
     """``concurrency`` clients, each submit→materialize back-to-back
     until ``n_requests`` total complete. Backpressure rejections honor
     the retry-after hint (bounded, so a saturated queue slows clients
-    down instead of losing work)."""
+    down instead of losing work). With ``mix`` each request samples its
+    target model by weight and the record carries per-model splits."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
 
     lock = threading.Lock()
     state = {"launched": 0, "completed": 0, "rejected": 0, "timed_out": 0}
     lats = []
+    sampler = _MixSampler(mix, images_by_model, images)
 
     def worker(wid: int):
         rng = np.random.default_rng(wid)
@@ -90,23 +161,33 @@ def run_closed_loop(batcher, images, concurrency: int, n_requests: int,
                 if state["launched"] >= n_requests:
                     return
                 state["launched"] += 1
-            img = images[int(rng.integers(len(images)))]
+            alias, pool = sampler.pick(rng)
+            img = pool[int(rng.integers(len(pool)))]
             t0 = time.perf_counter()
             try:
-                handle = batcher.submit(img)
+                handle = batcher.submit(img, model=alias)
                 handle.result(timeout=timeout_s)
             except Rejected as r:
                 with lock:
                     state["rejected"] += 1
+                    if alias is not None:
+                        sampler.tally(alias, "rejected")
                 time.sleep(min(r.retry_after_s, 0.2))
                 continue
-            except DeadlineExceeded:
+            except (DeadlineExceeded, _FutTimeout):
+                # a result that outlived timeout_s counts as timed out;
+                # the worker keeps driving load instead of dying
                 with lock:
                     state["timed_out"] += 1
+                    if alias is not None:
+                        sampler.tally(alias, "timed_out")
                 continue
+            lat = time.perf_counter() - t0
             with lock:
                 state["completed"] += 1
-                lats.append(time.perf_counter() - t0)
+                lats.append(lat)
+                if alias is not None:
+                    sampler.tally(alias, "completed", lat)
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(concurrency)]
@@ -117,19 +198,25 @@ def run_closed_loop(batcher, images, concurrency: int, n_requests: int,
         t.join()
     wall = time.perf_counter() - t0
     snap = batcher.telemetry.snapshot()
-    return {"mode": "closed", "concurrency": concurrency, **state,
-            "req_per_s": round(state["completed"] / wall, 1),
-            "wall_s": round(wall, 3), **_percentiles_ms(lats),
-            "batch_occupancy": snap["batch_occupancy"],
-            "queue_depth_mean": snap["queue_depth_mean"],
-            "shed_batches": snap["shed_batches"]}
+    rec = {"mode": "closed", "concurrency": concurrency, **state,
+           "req_per_s": round(state["completed"] / wall, 1),
+           "wall_s": round(wall, 3), **_percentiles_ms(lats),
+           "batch_occupancy": snap["batch_occupancy"],
+           "queue_depth_mean": snap["queue_depth_mean"],
+           "shed_batches": snap["shed_batches"]}
+    models = sampler.model_recs("closed", wall)
+    if models:
+        rec["models"] = models
+    return rec
 
 
 def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
-                  timeout_s: float = 10.0) -> dict:
+                  timeout_s: float = 10.0, mix=None,
+                  images_by_model=None) -> dict:
     """Fixed-rate arrivals: one submitter paces requests at ``rate_hz``;
     a resolver pool materializes results. Rejections are counted and
-    DROPPED (open-loop semantics — the arrival process never waits)."""
+    DROPPED (open-loop semantics — the arrival process never waits).
+    With ``mix`` each arrival samples its model by weight."""
     import queue as _queue
 
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
@@ -139,6 +226,7 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
     state = {"submitted": 0, "completed": 0, "rejected": 0,
              "timed_out": 0}
     lats = []
+    sampler = _MixSampler(mix, images_by_model, images)
     done = threading.Event()
 
     def resolver():
@@ -146,16 +234,21 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
             item = handles.get()
             if item is None:
                 return
-            t0, handle = item
+            t0, alias, handle = item
             try:
                 handle.result(timeout=timeout_s)
             except (DeadlineExceeded, Exception):  # noqa: BLE001
                 with lock:
                     state["timed_out"] += 1
+                    if alias is not None:
+                        sampler.tally(alias, "timed_out")
                 continue
+            lat = time.perf_counter() - t0
             with lock:
                 state["completed"] += 1
-                lats.append(time.perf_counter() - t0)
+                lats.append(lat)
+                if alias is not None:
+                    sampler.tally(alias, "completed", lat)
 
     pool = [threading.Thread(target=resolver, daemon=True)
             for _ in range(8)]
@@ -170,29 +263,36 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
         if now < next_t:
             time.sleep(next_t - now)
         next_t += period
-        img = images[int(rng.integers(len(images)))]
+        alias, img_pool = sampler.pick(rng)
+        img = img_pool[int(rng.integers(len(img_pool)))]
         t0 = time.perf_counter()
         try:
-            handle = batcher.submit(img)
+            handle = batcher.submit(img, model=alias)
         except Rejected:
             with lock:
                 state["rejected"] += 1
+                if alias is not None:
+                    sampler.tally(alias, "rejected")
             continue
         with lock:
             state["submitted"] += 1
-        handles.put((t0, handle))
+        handles.put((t0, alias, handle))
     for _ in pool:
         handles.put(None)
     for t in pool:
         t.join(timeout=timeout_s)
     done.set()
     snap = batcher.telemetry.snapshot()
-    return {"mode": "open", "rate_hz": rate_hz, **state,
-            "req_per_s": round(state["completed"] / duration_s, 1),
-            **_percentiles_ms(lats),
-            "batch_occupancy": snap["batch_occupancy"],
-            "queue_depth_mean": snap["queue_depth_mean"],
-            "shed_batches": snap["shed_batches"]}
+    rec = {"mode": "open", "rate_hz": rate_hz, **state,
+           "req_per_s": round(state["completed"] / duration_s, 1),
+           **_percentiles_ms(lats),
+           "batch_occupancy": snap["batch_occupancy"],
+           "queue_depth_mean": snap["queue_depth_mean"],
+           "shed_batches": snap["shed_batches"]}
+    models = sampler.model_recs("open", duration_s)
+    if models:
+        rec["models"] = models
+    return rec
 
 
 def append_serve_row(results_path: str, rec: dict, **extra) -> None:
@@ -235,24 +335,96 @@ def main(argv=None) -> int:
     ap.add_argument("--results", default=None,
                     help="append serve rows to this jsonl "
                          "(default: tools/mfu_results.jsonl; 'none' off)")
+    ap.add_argument("--mix", default=None,
+                    help='mixed zoo traffic, e.g. "a=0.7,b=0.3": each '
+                         "request samples its model by weight "
+                         "(closed/open modes; implies a ModelZoo)")
+    ap.add_argument("--zoo", default=None,
+                    help="tenant specs for --mix aliases: JSON (or "
+                         "@file.json) alias -> {model, num_classes, "
+                         "image_size, buckets, weight_quant, ...}; "
+                         "default: each alias IS its architecture name "
+                         "with the CLI's --num-classes/--size")
     args = ap.parse_args(argv)
+    if args.mix and args.mode not in ("closed", "open"):
+        ap.error("--mix needs --mode closed or open")
 
-    from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+    from deeplearning_tpu.serve import (InferenceEngine, MicroBatcher,
+                                        ModelZoo)
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    engine = InferenceEngine(
-        args.model, num_classes=args.num_classes, ckpt=args.ckpt,
-        image_size=args.size, batch_buckets=buckets)
-    images = make_images(max(buckets[-1], 64), args.size)
     results_path = args.results or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "mfu_results.jsonl")
     write_rows = (args.results or "").lower() != "none"
 
+    mix = zoo = None
+    images_by_model = {}
+    if args.mix:
+        mix = parse_mix(args.mix)
+        if args.zoo:
+            raw = args.zoo
+            if raw.startswith("@"):
+                with open(raw[1:]) as f:
+                    spec = json.load(f)
+            else:
+                spec = json.loads(raw)
+        else:
+            spec = {alias: {} for alias in mix}
+        zoo = ModelZoo()
+        for alias in mix:
+            row = dict(spec.get(alias, {}))
+            model_name = row.pop("model", alias)
+            b = row.pop("buckets", None)
+            row["batch_buckets"] = (tuple(int(x) for x in b)
+                                    if b else buckets)
+            row.setdefault("num_classes", args.num_classes)
+            row.setdefault("image_size", args.size)
+            zoo.register(
+                alias, model_name,
+                weight_quant=row.pop("weight_quant", "fp32"),
+                max_queue=int(row.pop("max_queue", args.max_queue)),
+                default_timeout_s=row.pop("timeout_s", args.timeout_s),
+                **row)
+        for alias in mix:       # measure serving, not cold loads
+            if zoo.load(alias, wait=True) != "warm":
+                ap.error(
+                    f"tenant {alias!r} failed to load: "
+                    f"{zoo.load_errors.get(alias, 'unknown')} — with no "
+                    "--zoo spec each --mix alias must BE an architecture "
+                    'name (or map it: --zoo \'{"%s": {"model": ...}}\')'
+                    % alias)
+            images_by_model[alias] = make_images(
+                max(buckets[-1], 64), zoo.image_size(alias))
+        images = next(iter(images_by_model.values()))
+        engine = None
+    else:
+        engine = InferenceEngine(
+            args.model, num_classes=args.num_classes, ckpt=args.ckpt,
+            image_size=args.size, batch_buckets=buckets)
+        images = make_images(max(buckets[-1], 64), args.size)
+
     def report(rec, **extra):
         print(json.dumps(rec), flush=True)
-        if write_rows:
+        if not write_rows:
+            return
+        models = rec.get("models")
+        if models:
+            # one op row per tenant, so the per-model latency
+            # trajectories land in mfu_results.jsonl individually
+            for alias, sub in sorted(models.items()):
+                append_serve_row(results_path, sub, model=alias,
+                                 mix_weight=sub["mix_weight"], **extra)
+        else:
             append_serve_row(results_path, rec, model=args.model,
                              **extra)
+
+    def make_batcher():
+        kwargs = dict(max_wait_ms=args.max_wait_ms,
+                      max_queue=args.max_queue,
+                      default_timeout_s=args.timeout_s)
+        if zoo is not None:
+            return MicroBatcher(zoo=zoo, **kwargs)
+        return MicroBatcher(engine, **kwargs)
 
     recs = []
     if args.mode in ("sequential", "compare"):
@@ -260,17 +432,17 @@ def main(argv=None) -> int:
         report(rec)
         recs.append(rec)
     if args.mode in ("closed", "compare"):
-        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
-                          max_queue=args.max_queue,
-                          default_timeout_s=args.timeout_s) as mb:
-            rec = run_closed_loop(mb, images, args.concurrency, args.n)
+        with make_batcher() as mb:
+            rec = run_closed_loop(mb, images, args.concurrency, args.n,
+                                  mix=mix,
+                                  images_by_model=images_by_model)
         report(rec)
         recs.append(rec)
     if args.mode == "open":
-        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
-                          max_queue=args.max_queue,
-                          default_timeout_s=args.timeout_s) as mb:
-            rec = run_open_loop(mb, images, args.rate, args.duration)
+        with make_batcher() as mb:
+            rec = run_open_loop(mb, images, args.rate, args.duration,
+                                mix=mix,
+                                images_by_model=images_by_model)
         report(rec)
         recs.append(rec)
     if args.mode == "compare" and len(recs) == 2:

@@ -145,6 +145,68 @@ def fleet_summary(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     return out
 
 
+_LABELED_KEY = None    # compiled lazily (re import below)
+
+
+def zoo_summary(reg: Optional[Dict[str, Any]],
+                fleet_rows: List[Dict[str, Any]],
+                child_flight: Optional[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+    """Multi-tenant posture: per-model warm/bytes/traffic from the
+    registry snapshot's ``model``-labeled series, per-model qps/p99/SLO
+    from the last fleet rollup's ``models`` fold, and load/evict/reject
+    counts from the flight record. None when the run served no zoo."""
+    import re
+    global _LABELED_KEY
+    if _LABELED_KEY is None:
+        _LABELED_KEY = re.compile(
+            r'^(?P<name>[A-Za-z0-9_:]+)\{model="(?P<model>[^"]+)"\}$')
+    models: Dict[str, Dict[str, Any]] = {}
+    out: Dict[str, Any] = {}
+    per_model_keys = {
+        "dltpu_zoo_model_warm": ("warm", bool),
+        "dltpu_zoo_model_bytes": ("bytes", int),
+        "dltpu_serve_requests_total": ("requests", float),
+        "dltpu_serve_rejected_total": ("rejected", float),
+        "dltpu_serve_e2e_ms_p99": ("e2e_ms_p99", float),
+    }
+    metrics = (reg or {}).get("metrics") or {}
+    for key, sample in metrics.items():
+        m = _LABELED_KEY.match(key)
+        if not m or not isinstance(sample, dict) or "value" not in sample:
+            continue
+        mapped = per_model_keys.get(m["name"])
+        if mapped:
+            field, cast = mapped
+            models.setdefault(m["model"], {})[field] = \
+                cast(sample["value"])
+    for key, short in (("dltpu_zoo_resident_models", "resident"),
+                       ("dltpu_zoo_loads_total", "loads"),
+                       ("dltpu_zoo_evictions_total", "evictions"),
+                       ("dltpu_zoo_load_rejects_total", "load_rejects")):
+        sample = metrics.get(key)
+        if isinstance(sample, dict) and "value" in sample:
+            out[short] = sample["value"]
+    if fleet_rows:
+        for alias, frow in (fleet_rows[-1].get("models") or {}).items():
+            row = models.setdefault(alias, {})
+            row["qps"] = frow.get("qps_total")
+            row["p99_ms"] = frow.get("e2e_ms_p99_max")
+            slo = frow.get("slo") or {}
+            if slo:
+                row["slo_breach"] = bool(slo.get("breach"))
+    if child_flight is not None:
+        for e in child_flight.get("events", []):
+            kind = e.get("kind")
+            if kind in ("zoo_load", "zoo_evict", "zoo_load_failed",
+                        "zoo_load_rejected"):
+                out[kind + "_events"] = out.get(kind + "_events", 0) + 1
+    if not models and not out:
+        return None
+    out["models"] = models
+    return out
+
+
 def load_metrics(run_dir: str) -> List[Dict[str, Any]]:
     path = os.path.join(run_dir, "metrics.jsonl")
     if not os.path.exists(path):
@@ -250,13 +312,19 @@ def summarize(run_dir: str) -> Dict[str, Any]:
                 k: v for k, v in last.items()
                 if isinstance(v, (int, float)) and k != "time"}
 
-    registry = registry_summary(load_registry(run_dir))
+    registry_raw = load_registry(run_dir)
+    registry = registry_summary(registry_raw)
     if registry:
         out["registry"] = registry
 
-    fleet = fleet_summary(load_fleet(run_dir))
+    fleet_rows = load_fleet(run_dir)
+    fleet = fleet_summary(fleet_rows)
     if fleet:
         out["fleet"] = fleet
+
+    zoo = zoo_summary(registry_raw, fleet_rows, flight)
+    if zoo:
+        out["zoo"] = zoo
 
     analysis = analysis_summary()
     if analysis:
@@ -509,6 +577,35 @@ def render(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  SLO: {ft['slo_breach_polls']}/{ft['polls']} poll(s) "
                 f"in breach (budget {budgets})")
+    z = summary.get("zoo")
+    if z:
+        lines.append("")
+        head = (f"zoo: {len(z['models'])} model(s)"
+                f" resident={z.get('resident', '?')}"
+                f" loads={z.get('loads', 0)}"
+                f" evictions={z.get('evictions', 0)}"
+                f" load_rejects={z.get('load_rejects', 0)}")
+        evs = [f"{k[:-len('_events')]}×{v}" for k, v in sorted(z.items())
+               if k.endswith("_events")]
+        if evs:
+            head += "  [" + " ".join(evs) + "]"
+        lines.append(head)
+        for alias, row in sorted(z["models"].items()):
+            bits = []
+            if "warm" in row:
+                bits.append("warm" if row["warm"] else "cold")
+            if row.get("bytes"):
+                bits.append(f"{row['bytes']}B")
+            if row.get("requests") is not None:
+                bits.append(f"req={row['requests']:.0f}")
+            if row.get("qps") is not None:
+                bits.append(f"qps={row['qps']:.1f}")
+            p99 = row.get("p99_ms", row.get("e2e_ms_p99"))
+            if p99 is not None:
+                bits.append(f"p99={p99:.1f}ms")
+            if row.get("slo_breach"):
+                bits.append("SLO-BREACH")
+            lines.append(f"  {alias}: " + " ".join(bits))
     a = summary.get("analysis")
     if a:
         lines.append("")
@@ -640,6 +737,19 @@ def _check() -> int:
         regy.counter("dltpu_recovery_rollbacks_total").inc()
         regy.gauge("dltpu_train_step").set(17)
         regy.histogram("dltpu_step_ms", buckets=(1.0, 10.0)).observe(3.0)
+        # zoo posture: per-model labeled series + residency counters,
+        # exactly what the serve collector mirrors in zoo mode
+        regy.gauge("dltpu_zoo_resident_models").set(2)
+        regy.counter("dltpu_zoo_loads_total").inc(3)
+        regy.counter("dltpu_zoo_evictions_total").inc()
+        for alias, warm, nbytes, reqs in (("alpha", 1.0, 5354536, 30.0),
+                                          ("beta", 0.0, 1361872, 12.0)):
+            labels = {"model": alias}
+            regy.gauge("dltpu_zoo_model_warm", labels=labels).set(warm)
+            regy.gauge("dltpu_zoo_model_bytes",
+                       labels=labels).set(nbytes)
+            regy.counter("dltpu_serve_requests_total",
+                         labels=labels).inc(reqs)
         regy.dump(os.path.join(run_dir, "metrics_registry.json"))
 
         # fleet.jsonl through the real rollup/SLO fold: one healthy
@@ -653,7 +763,14 @@ def _check() -> int:
                                 "dltpu_serve_requests_total": 100.0,
                                 "dltpu_serve_completed_total": 99.0,
                                 "dltpu_serve_rejected_total": 1.0,
-                                "dltpu_serve_timed_out_total": 0.0}}
+                                "dltpu_serve_timed_out_total": 0.0},
+                    # per-tenant fold input (zoo replicas label their
+                    # serve series; scrape_replica groups them here)
+                    "by_model": {"alpha": {
+                        "dltpu_serve_requests_per_s": qps / 2,
+                        "dltpu_serve_e2e_ms_p99": p99,
+                        "dltpu_serve_requests_total": 50.0,
+                        "dltpu_serve_rejected_total": 1.0}}}
         slo = fleet_mod.SLOPolicy(p99_budget_ms=10.0,
                                   error_rate_budget=0.5)
         with open(os.path.join(run_dir, "fleet.jsonl"), "w") as f:
@@ -711,7 +828,7 @@ def _check() -> int:
         assert abs(ftl["e2e_ms_p99_max_peak"] - 40.0) < 1e-9, ftl
         assert ftl["slo_breach_polls"] == 1, ftl
         assert ftl["slo"]["p99_breach"] and ftl["slo"]["breach"], ftl
-        for token in ("registry: 4 metric(s)",
+        for token in ("registry: 13 metric(s)",
                       "dltpu_serve_requests_total=42.0",
                       "fleet: 2 poll(s), 2 replica(s)",
                       "SLO: 1/2 poll(s) in breach"):
@@ -719,6 +836,20 @@ def _check() -> int:
         fleet_view = render_fleet(run_dir)
         assert "BREACH (p99)" in fleet_view, fleet_view
         assert fleet_view.count("\n") >= 5, fleet_view
+        # zoo posture section: registry labels + fleet per-model fold
+        zz = summary["zoo"]
+        assert zz["resident"] == 2.0 and zz["loads"] == 3.0, zz
+        assert zz["evictions"] == 1.0, zz
+        assert zz["models"]["alpha"]["warm"] is True, zz
+        assert zz["models"]["beta"]["warm"] is False, zz
+        assert zz["models"]["alpha"]["bytes"] == 5354536, zz
+        assert zz["models"]["alpha"]["requests"] == 30.0, zz
+        assert zz["models"]["alpha"]["qps"] == 8.0, zz
+        assert zz["models"]["alpha"]["p99_ms"] == 40.0, zz
+        assert zz["models"]["alpha"]["slo_breach"] is True, zz
+        for token in ("zoo: 2 model(s)", "evictions=1",
+                      "alpha: warm", "SLO-BREACH", "beta: cold"):
+            assert token in report, report
         # dltpu-check posture line: rules enabled + committed baseline
         ana = summary["analysis"]
         assert ana["rules"] >= 6, ana

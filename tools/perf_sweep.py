@@ -384,6 +384,65 @@ def time_serve_set(results_path=None):
                              model="vit_base_patch16_224")
 
 
+def time_zoo_set(results_path=None):
+    """Multi-tenant residency sweep (serve/zoo.py): per-model e2e p99
+    for a model served SOLO vs as one of THREE residents taking mixed
+    traffic, at fp32 vs int8 weight residency. Each variant row carries
+    the zoo's resident weight bytes, the backend's ``hbm_snapshot``
+    bytes-in-use (0 on CPU — no memory_stats), and the eviction count,
+    so the density claim (int8 ≈ 4× more models per chip) and the
+    isolation claim (a co-resident's p99 stays near solo) are both read
+    off mfu_results.jsonl."""
+    from loadgen import append_serve_row, make_images, run_closed_loop
+
+    from deeplearning_tpu.obs.xla import hbm_snapshot
+    from deeplearning_tpu.serve import MicroBatcher, ModelZoo
+
+    def hbm_in_use():
+        snap = hbm_snapshot()
+        return sum(int(d.get("bytes_in_use") or 0)
+                   for d in snap.get("devices") or [])
+
+    tenants = {"fcn_a": "mnist_fcn", "fcn_b": "mnist_fcn",
+               "cnn": "mnist_cnn"}
+    buckets = (1, 8, 32)
+    n_req, conc = 192, 16
+    images = {a: make_images(buckets[-1], 28) for a in tenants}
+
+    for quant in ("fp32", "int8"):
+        for label, aliases in (("solo", ["fcn_a"]),
+                               ("resident3", sorted(tenants))):
+            zoo = ModelZoo()
+            for alias in aliases:
+                zoo.register(alias, tenants[alias], weight_quant=quant,
+                             num_classes=10, image_size=28,
+                             batch_buckets=buckets)
+                zoo.load(alias, wait=True)
+            mix = {a: 1.0 / len(aliases) for a in aliases}
+            with MicroBatcher(zoo=zoo, max_wait_ms=2.0) as mb:
+                rec = run_closed_loop(mb, images[aliases[0]], conc,
+                                      n_req, mix=mix,
+                                      images_by_model=images)
+            zs = zoo.stats()
+            resident_bytes = sum(m["bytes"]
+                                 for m in zs["models"].values())
+            row_name = f"zoo_{label}_{quant}"
+            print(f"{row_name:22s} req/s={rec['req_per_s']:8.1f} "
+                  f"weights={resident_bytes:9d}B "
+                  f"hbm={hbm_in_use():11d}B "
+                  f"evictions={zs['evictions']}", flush=True)
+            for alias, sub in sorted(rec["models"].items()):
+                print(f"  {alias:8s} p99={sub['p99_ms']:8.2f} ms "
+                      f"completed={sub['completed']}", flush=True)
+                if results_path:
+                    append_serve_row(
+                        results_path, sub, model=alias, variant=row_name,
+                        weight_quant=quant, residency=len(aliases),
+                        resident_bytes=resident_bytes,
+                        hbm_bytes_in_use=hbm_in_use(),
+                        evictions=zs["evictions"])
+
+
 def time_obs_set(results_path=None):
     """Observability-overhead A/B (obs/spans.py): the same jitted train
     step timed with span tracing disabled vs enabled (per-step
@@ -524,7 +583,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--set", default="batch",
                     choices=["batch", "attn", "all", "r5", "decomp",
-                             "feed", "detect", "serve", "obs", "shard"])
+                             "feed", "detect", "serve", "obs", "shard",
+                             "zoo"])
     args = ap.parse_args()
 
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -554,6 +614,8 @@ def main():
         time_detect_set(results_path=results)
     if args.set == "serve":
         time_serve_set(results_path=results)
+    if args.set == "zoo":
+        time_zoo_set(results_path=results)
     if args.set == "obs":
         time_obs_set(results_path=results)
     if args.set == "shard":

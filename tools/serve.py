@@ -131,43 +131,81 @@ def serve_stdin(batcher, task: str, size: int, names, topk: int,
     return 0
 
 
+_SERVE_COUNTER_NAMES = {
+    "submitted": "dltpu_serve_requests_total",
+    "completed": "dltpu_serve_completed_total",
+    "rejected": "dltpu_serve_rejected_total",
+    "timed_out": "dltpu_serve_timed_out_total",
+    "batches": "dltpu_serve_batches_total",
+    "shed_batches": "dltpu_serve_shed_batches_total",
+}
+_SERVE_GAUGE_KEYS = (
+    "requests_per_s", "rejects_per_s", "completions_per_s", "window_s",
+    "batch_occupancy", "queue_depth_mean", "e2e_ms_p50", "e2e_ms_p90",
+    "e2e_ms_p99", "dispatch_ms_p50", "dispatch_ms_p90",
+    "dispatch_ms_p99")
+
+
+def _mirror_telemetry(reg, snap, labels=None):
+    for key, name in _SERVE_COUNTER_NAMES.items():
+        reg.counter(name, f"serve telemetry {key}",
+                    labels=labels).set_total(snap.get(key, 0.0))
+    for key in _SERVE_GAUGE_KEYS:
+        if key in snap:
+            reg.gauge(f"dltpu_serve_{key}", f"serve telemetry {key}",
+                      labels=labels).set(snap[key])
+
+
 def make_metrics_collector(batcher):
     """Scrape-time pull adapter: mirror ``ServeTelemetry.snapshot()``
     (rates, percentiles, cumulative counts) and ``engine.stats()`` into
     the registry under the ``dltpu_serve_*`` names ``obs/fleet.py``
     rolls up. Counters use ``set_total`` (monotonic mirror); xla-side
     compile/HBM metrics are PUSHED by obs.xla and deliberately not
-    mirrored here — one writer per metric, never two."""
-    counter_names = {
-        "submitted": "dltpu_serve_requests_total",
-        "completed": "dltpu_serve_completed_total",
-        "rejected": "dltpu_serve_rejected_total",
-        "timed_out": "dltpu_serve_timed_out_total",
-        "batches": "dltpu_serve_batches_total",
-        "shed_batches": "dltpu_serve_shed_batches_total",
-    }
+    mirrored here — one writer per metric, never two.
+
+    Zoo mode additionally mirrors every tenant lane under the SAME
+    metric names with a ``model`` label (the per-tenant series
+    ``fleet.compute_rollup`` folds into its ``models`` section) plus
+    per-model queue/warm gauges and the zoo residency counters."""
 
     def _collect(reg):
         snap = batcher.telemetry.snapshot()
-        for key, name in counter_names.items():
-            reg.counter(name, f"serve telemetry {key}").set_total(
-                snap.get(key, 0.0))
-        for key in ("requests_per_s", "rejects_per_s",
-                    "completions_per_s", "window_s", "batch_occupancy",
-                    "queue_depth_mean", "e2e_ms_p50", "e2e_ms_p90",
-                    "e2e_ms_p99", "dispatch_ms_p50", "dispatch_ms_p90",
-                    "dispatch_ms_p99"):
-            if key in snap:
-                reg.gauge(f"dltpu_serve_{key}",
-                          f"serve telemetry {key}").set(snap[key])
+        _mirror_telemetry(reg, snap)
         reg.gauge("dltpu_serve_queue_depth",
                   "live micro-batch queue depth").set(
             float(batcher.queue_depth))
-        for key, val in batcher.engine.stats().items():
-            if isinstance(val, (int, float)) and not isinstance(val, bool):
-                safe = "".join(c if c.isalnum() else "_" for c in key)
-                reg.gauge(f"dltpu_engine_{safe}",
-                          f"engine stats {key}").set(float(val))
+        if batcher.zoo is None:
+            for key, val in batcher.engine.stats().items():
+                if isinstance(val, (int, float)) \
+                        and not isinstance(val, bool):
+                    safe = "".join(c if c.isalnum() else "_"
+                                   for c in key)
+                    reg.gauge(f"dltpu_engine_{safe}",
+                              f"engine stats {key}").set(float(val))
+            return
+        zs = batcher.zoo.stats()
+        for key in ("registered", "resident", "loads", "evictions",
+                    "rejected_loads"):
+            reg.gauge(f"dltpu_zoo_{key}",
+                      f"zoo {key}").set(float(zs[key]))
+        for alias, row in zs["models"].items():
+            labels = {"model": alias}
+            lane_tel = batcher.lane_telemetry(alias)
+            if lane_tel is not None:
+                _mirror_telemetry(reg, lane_tel.snapshot(), labels)
+            reg.gauge("dltpu_serve_queue_depth",
+                      "live micro-batch queue depth",
+                      labels=labels).set(
+                float(batcher.lane_depth(alias)))
+            reg.gauge("dltpu_zoo_model_warm", "1 while servable",
+                      labels=labels).set(1.0 if row["warm"] else 0.0)
+            reg.gauge("dltpu_zoo_model_bytes", "resident weight bytes",
+                      labels=labels).set(float(row["bytes"]))
+            if "trace_count" in row:
+                reg.gauge("dltpu_zoo_model_trace_count",
+                          "engine trace count", labels=labels).set(
+                    float(row["trace_count"]))
     return _collect
 
 
@@ -181,7 +219,14 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     503 with ``"wedged": true``, so a balancer drains a stuck replica
     the process itself cannot notice); GET /metrics + /metrics.json →
     the fleet scrape surface. ThreadingHTTPServer gives each request
-    its own thread, so concurrent posts micro-batch."""
+    its own thread, so concurrent posts micro-batch.
+
+    Zoo mode (``batcher.zoo`` set) adds the multi-tenant surface:
+    ``POST /predict/<model>`` routes to that tenant's lane (a cold
+    tenant hot-loads in the background; HBM-pressure refusals answer
+    429 with the model and reason in the body), ``GET /models`` dumps
+    the per-tenant state table, and ``POST /admin/load/<model>`` /
+    ``POST /admin/evict/<model>`` drive residency by hand."""
     import io
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -190,7 +235,9 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     from deeplearning_tpu.serve import DeadlineExceeded, Rejected
     from deeplearning_tpu.serve.health import DispatchWatch
     from deeplearning_tpu.serve.health import health as health_check
+    from deeplearning_tpu.serve.health import zoo_health
 
+    zoo = batcher.zoo
     watch = DispatchWatch(batcher, wedge_deadline_s)
     registry = obs_metrics.enable()
     registry.register_collector(make_metrics_collector(batcher))
@@ -207,17 +254,37 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
             self.end_headers()
             self.wfile.write(body)
 
+        def _rejected(self, r):
+            body = json.dumps({
+                "error": "rejected", "reason": r.reason,
+                "model": r.model, "depth": r.depth,
+                "retry_after_s": round(r.retry_after_s, 3)}).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", f"{r.retry_after_s:.3f}")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             route = self.path.rstrip("/")
             if route == "/stats":
                 payload = batcher.telemetry.snapshot()
-                payload["engine"] = batcher.engine.stats()
+                if zoo is None:
+                    payload["engine"] = batcher.engine.stats()
+                else:
+                    payload["zoo"] = zoo.stats()
                 payload["compile"] = obs_xla.compile_stats()
                 payload["hbm"] = obs_xla.hbm_snapshot()
                 return self._json(200, payload)
+            if route == "/models" and zoo is not None:
+                return self._json(200, zoo.stats())
             if route == "/healthz":
-                code, payload = health_check(batcher.engine, batcher,
-                                             wedge=watch)
+                if zoo is None:
+                    code, payload = health_check(batcher.engine, batcher,
+                                                 wedge=watch)
+                else:
+                    code, payload = zoo_health(zoo, batcher, wedge=watch)
                 payload.update(obs_metrics.replica_identity())
                 return self._json(code, payload)
             if route == "/metrics":
@@ -235,9 +302,7 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
             return self._json(404, {"error": "GET /stats, /healthz, "
                                              "/metrics or /metrics.json"})
 
-        def do_POST(self):
-            if self.path.rstrip("/") != "/predict":
-                return self._json(404, {"error": "POST /predict only"})
+        def _predict(self, alias):
             n = int(self.headers.get("Content-Length", 0))
             try:
                 arr = np.load(io.BytesIO(self.rfile.read(n)),
@@ -245,35 +310,132 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                 images = np.asarray(arr, np.float32)
                 if images.ndim == 3:
                     images = images[None]
-                handles = [batcher.submit(img) for img in images]
+                handles = [batcher.submit(img, model=alias)
+                           for img in images]
                 rows = [h.result(timeout=timeout_s) for h in handles]
             except Rejected as r:
-                self.send_response_only(429)
-                self.send_header("Retry-After",
-                                 f"{r.retry_after_s:.3f}")
-                self.end_headers()
-                return None
+                return self._rejected(r)
             except DeadlineExceeded:
                 return self._json(504, {"error": "deadline_exceeded"})
+            except KeyError as e:
+                return self._json(404, {"error": repr(e)})
             except Exception as e:  # noqa: BLE001 - request-scoped
                 return self._json(400, {"error": repr(e)})
+            if zoo is None:
+                row_task = task
+            else:
+                # the engine served the batch, so it was warm a moment
+                # ago; a racing evict just means we format as classify
+                eng = zoo.engine(alias or zoo.models()[0])
+                row_task = eng.task if eng is not None else "classify"
             return self._json(200, {"results": [
-                format_answer(task, row, names, topk) for row in rows]})
+                format_answer(row_task, row, names, topk)
+                for row in rows]})
+
+        def do_POST(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts and parts[0] == "predict":
+                if len(parts) == 1:
+                    return self._predict(None)
+                if len(parts) == 2 and zoo is not None:
+                    return self._predict(parts[1])
+            elif (zoo is not None and len(parts) == 3
+                    and parts[0] == "admin"
+                    and parts[1] in ("load", "evict")):
+                verb, alias = parts[1], parts[2]
+                try:
+                    if verb == "load":
+                        state = zoo.load(alias, wait=False)
+                    else:
+                        evicted = zoo.evict(alias)
+                        state = zoo.state(alias)
+                except Rejected as r:
+                    return self._rejected(r)
+                except KeyError as e:
+                    return self._json(404, {"error": repr(e)})
+                out = {"model": alias, "state": state}
+                if verb == "evict":
+                    out["evicted"] = evicted
+                return self._json(200, out)
+            return self._json(404, {
+                "error": "POST /predict[/<model>] or "
+                         "/admin/{load,evict}/<model>"})
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     url = f"http://127.0.0.1:{server.server_port}"
     # advertise the scrape endpoint when a supervisor asked for it
     obs_metrics.write_endpoint(url, role="serve")
-    print(json.dumps({"serving": url,
-                      "endpoints": ["/predict", "/stats", "/healthz",
-                                    "/metrics", "/metrics.json"]}),
+    endpoints = ["/predict", "/stats", "/healthz", "/metrics",
+                 "/metrics.json"]
+    if zoo is not None:
+        endpoints[:1] = ["/predict/<model>", "/models",
+                         "/admin/load/<model>", "/admin/evict/<model>"]
+    print(json.dumps({"serving": url, "endpoints": endpoints}),
           flush=True)
     return server
 
 
+def parse_zoo_spec(raw: str) -> dict:
+    """``--zoo`` value: inline JSON or ``@file.json`` mapping alias →
+    tenant spec. Per-tenant keys: ``model`` (architecture name,
+    defaults to the alias), policy keys (``weight_quant``,
+    ``max_queue``, ``shed_threshold``, ``timeout_s``, ``est_bytes``,
+    ``preload``), ``buckets`` (list), and everything else passes
+    through as engine kwargs (``num_classes``, ``image_size``,
+    ``ckpt``, ...)."""
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            spec = json.load(f)
+    else:
+        spec = json.loads(raw)
+    if not isinstance(spec, dict) or not spec:
+        raise ValueError("--zoo must map alias -> tenant spec")
+    return spec
+
+
+def build_zoo(spec: dict, args):
+    """ModelZoo from a parsed ``--zoo`` spec + CLI defaults."""
+    from deeplearning_tpu.serve import ModelZoo
+    zoo = ModelZoo(alert_frac=args.hbm_alert_frac,
+                   max_resident=args.max_resident)
+    preload = []
+    for alias, row in spec.items():
+        row = dict(row)
+        model_name = row.pop("model", alias)
+        if row.pop("preload", False):
+            preload.append(alias)
+        buckets = row.pop("buckets", None)
+        if buckets is not None:
+            row["batch_buckets"] = tuple(int(b) for b in buckets)
+        row.setdefault("batch_buckets", tuple(
+            int(b) for b in args.buckets.split(",")))
+        zoo.register(
+            alias, model_name,
+            weight_quant=row.pop("weight_quant", "fp32"),
+            max_queue=int(row.pop("max_queue", args.max_queue)),
+            shed_threshold=row.pop("shed_threshold", None),
+            default_timeout_s=row.pop("timeout_s", args.timeout_s),
+            est_bytes=row.pop("est_bytes", None),
+            **row)
+    for alias in preload:
+        zoo.load(alias, wait=True)
+    return zoo
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", required=True)
+    ap.add_argument("--model", default=None,
+                    help="single-model mode: architecture to serve")
+    ap.add_argument("--zoo", default=None,
+                    help="multi-tenant mode: JSON (or @file.json) "
+                         "mapping alias -> tenant spec; see "
+                         "parse_zoo_spec")
+    ap.add_argument("--max-resident", type=int, default=None,
+                    help="zoo: cap on simultaneously-warm models")
+    ap.add_argument("--hbm-alert-frac", type=float, default=None,
+                    help="zoo: evict when a load projects past this "
+                         "HBM fraction (default DLTPU_HBM_ALERT_FRAC "
+                         "or 0.9)")
     ap.add_argument("--num-classes", type=int, default=10)
     ap.add_argument("--ckpt", default=None)
     ap.add_argument("--size", type=int, default=224)
@@ -299,6 +461,10 @@ def main(argv=None) -> int:
                     help="healthz reports wedged after this many seconds "
                          "of queued-but-frozen dispatch")
     args = ap.parse_args(argv)
+    if (args.model is None) == (args.zoo is None):
+        ap.error("pass exactly one of --model or --zoo")
+    if args.zoo is not None and args.http is None:
+        ap.error("--zoo requires --http (stdin mode is single-model)")
 
     from deeplearning_tpu.elastic import heartbeat as hb
     from deeplearning_tpu.obs import spans
@@ -314,14 +480,22 @@ def main(argv=None) -> int:
         trace_path = os.environ.get("DLTPU_TRACE_FILE") or os.path.join(
             os.path.dirname(ep) if ep else ".", "trace.json")
 
-    engine = InferenceEngine(
-        args.model, num_classes=args.num_classes, ckpt=args.ckpt,
-        image_size=args.size,
-        batch_buckets=tuple(int(b) for b in args.buckets.split(",")),
-        tta=args.tta, score_thresh=args.score, max_det=args.max_det,
-        nms_impl=args.nms_impl)
-    print(json.dumps({"ready": engine.stats()}), file=sys.stderr,
-          flush=True)
+    engine = zoo = None
+    if args.zoo is not None:
+        zoo = build_zoo(parse_zoo_spec(args.zoo), args)
+        print(json.dumps({"ready": zoo.stats()}), file=sys.stderr,
+              flush=True)
+        task, size = "classify", 0     # resolved per model per request
+    else:
+        engine = InferenceEngine(
+            args.model, num_classes=args.num_classes, ckpt=args.ckpt,
+            image_size=args.size,
+            batch_buckets=tuple(int(b) for b in args.buckets.split(",")),
+            tta=args.tta, score_thresh=args.score, max_det=args.max_det,
+            nms_impl=args.nms_impl)
+        print(json.dumps({"ready": engine.stats()}), file=sys.stderr,
+              flush=True)
+        task, size = engine.task, args.size
     names = {}
     if args.classes:
         with open(args.classes) as f:
@@ -337,12 +511,13 @@ def main(argv=None) -> int:
         beat = hb.Heartbeat()
         writer = hb.HeartbeatWriter(beat_path, beat).start()
     try:
-        with MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+        with MicroBatcher(engine, zoo=zoo,
+                          max_wait_ms=args.max_wait_ms,
                           max_queue=args.max_queue,
                           default_timeout_s=args.timeout_s,
                           heartbeat=beat) as batcher:
             if args.http is not None:
-                server = serve_http(batcher, engine.task, args.size,
+                server = serve_http(batcher, task, size,
                                     names, args.topk, args.timeout_s,
                                     args.http, args.wedge_deadline_s)
 
@@ -368,7 +543,7 @@ def main(argv=None) -> int:
                 finally:
                     server.server_close()
                 return 0
-            return serve_stdin(batcher, engine.task, args.size, names,
+            return serve_stdin(batcher, task, size, names,
                                args.topk, args.timeout_s)
     finally:
         if trace_path is not None:
