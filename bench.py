@@ -353,6 +353,22 @@ def _shard_smoke():
             "ratio": round(z1 / rep, 4) if rep else None}
 
 
+def _concurrency_status():
+    """dltpu-check v2 ratchet verdict (DLT2xx): was this number measured
+    on a tree whose thread fleet passes the lock-discipline audit?"""
+    from deeplearning_tpu.analysis import concurrency
+
+    t0 = time.perf_counter()
+    status = concurrency.ratchet_status()
+    return {
+        "clean": status["clean"],
+        "findings": status["findings"],
+        "baseline_findings": status["baseline_findings"],
+        "new_groups": status["new_groups"],
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _lint_status():
     """dltpu-check ratchet verdict for the bench record: a perf number
     from a tree with NEW policy findings (a stray hot-loop sync, a
@@ -437,6 +453,11 @@ def _health_probe():
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["lint_clean"] = {"error": repr(e)}
         progress[0] += 1
+        try:
+            cpu_fallback["concurrency_clean"] = _concurrency_status()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["concurrency_clean"] = {"error": repr(e)}
+        progress[0] += 1
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
             "vs_baseline": 0.0, "error": "health probe timeout: device "
@@ -480,7 +501,8 @@ def peak_flops(device) -> float:
 
 
 def main():
-    threading.Thread(target=_watchdog, daemon=True).start()
+    from deeplearning_tpu.obs import threads as obs_threads
+    obs_threads.spawn(_watchdog, name="bench-watchdog", daemon=True)
     _health_probe()
     from deeplearning_tpu.core.registry import MODELS
     from deeplearning_tpu.train import TrainState, make_train_step
@@ -579,6 +601,11 @@ def main():
         rec["lint_clean"] = _lint_status()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["lint_clean"] = {"error": repr(e)}
+    try:
+        # dltpu-check v2: ...and on a lock-discipline-clean thread fleet?
+        rec["concurrency_clean"] = _concurrency_status()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["concurrency_clean"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

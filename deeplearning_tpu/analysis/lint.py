@@ -453,6 +453,24 @@ def _rule_dlt103(idx, al, path, add) -> None:
         elif isinstance(fn_arg, ast.Lambda):
             handlers.append(fn_arg)
 
+    # one level of callee resolution: a handler that merely delegates
+    # (``def _on_term(...): _do_dump()``) used to hide its I/O from
+    # this rule — any same-module function/method the handler body
+    # calls is scanned with it
+    for h in list(handlers):
+        body = h.body if isinstance(h.body, list) else [h.body]
+        for node in body:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = None
+                if isinstance(sub.func, ast.Name):
+                    callee = defs_by_name.get(sub.func.id)
+                elif isinstance(sub.func, ast.Attribute):
+                    callee = defs_by_name.get(sub.func.attr)
+                if callee is not None:
+                    handlers.append(callee)
+
     seen = set()
     for h in handlers:
         if id(h) in seen:

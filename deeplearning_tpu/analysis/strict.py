@@ -10,6 +10,10 @@ error at the exact offending line. ``strict="nans"`` arms
 ``jax_debug_nans`` for the whole run (composes with the
 ``train/recovery.py`` fault injection: the injected NaN is caught at the
 emitting primitive instead of steps later in the metrics ring).
+``strict="threads"`` (``DLTPU_STRICT=threads``) arms the runtime thread
+sanitizer (``analysis/threadsan.py``): instrumented Lock/RLock in the
+serving/elastic fleet modules, lock-order cycle detection seeded from
+the static graph, flightrec-style autopsy on violation.
 
 Caveat the tests rely on: the CPU backend shares one address space with
 the host, so device→host "transfers" are zero-copy views and the d2h
@@ -30,9 +34,10 @@ import jax
 __all__ = [
     "MODES", "resolve", "no_host_transfers", "no_transfers",
     "debug_nans", "strict_section", "guard_enforced", "StrictError",
+    "maybe_enable_threads",
 ]
 
-MODES = ("transfers", "nans")
+MODES = ("transfers", "nans", "threads")
 
 # what a bare opt-in ("1", "true", "on", "all") arms
 _DEFAULT_MODES = frozenset({"transfers"})
@@ -67,6 +72,19 @@ def resolve(value: Union[str, bool, None] = None,
             f"unknown strict mode(s) {sorted(unknown)}; "
             f"valid: {MODES}, '1'/'all', or ''")
     return modes
+
+
+def maybe_enable_threads(modes: FrozenSet[str]) -> bool:
+    """Arm the runtime thread sanitizer when ``"threads"`` is in the
+    resolved mode set. Called once per entry point (Trainer._obs_start,
+    tools/serve.py) BEFORE the fleet objects construct their locks —
+    enable() instruments module ``threading`` attributes, so locks
+    created earlier stay raw."""
+    if "threads" not in modes:
+        return False
+    from . import threadsan
+    threadsan.enable()
+    return True
 
 
 @contextlib.contextmanager

@@ -38,6 +38,7 @@ import jax
 import numpy as np
 
 from ..obs import spans
+from ..obs import threads as obs_threads
 from ..parallel.sharding import make_global_array
 
 _END = object()          # producer exhausted its epoch normally
@@ -197,10 +198,9 @@ class DevicePrefetcher:
     def _start(self) -> Dict[str, Any]:
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
-        thread = threading.Thread(
-            target=self._worker, args=(iter(self.loader), q, stop),
+        thread = obs_threads.spawn(
+            self._worker, args=(iter(self.loader), q, stop),
             name="device-prefetch", daemon=True)
-        thread.start()
         return {"queue": q, "stop": stop, "thread": thread,
                 "epoch": self.epoch}
 

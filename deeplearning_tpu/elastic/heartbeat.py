@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..obs import threads as obs_threads
+
 __all__ = ["Heartbeat", "HeartbeatWriter", "read_heartbeat", "ENV_VAR",
            "RUN_ID_VAR", "REPLICA_VAR"]
 
@@ -104,9 +106,8 @@ class HeartbeatWriter:
     def start(self) -> "HeartbeatWriter":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="elastic-heartbeat", daemon=True)
-            self._thread.start()
+            self._thread = obs_threads.spawn(
+                self._run, name="elastic-heartbeat", daemon=True)
         return self
 
     def stop(self, timeout: float = 2.0) -> None:

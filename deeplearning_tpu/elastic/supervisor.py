@@ -41,6 +41,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from . import faults, heartbeat
+from ..obs import threads as obs_threads
 from .preempt import EXIT_PREEMPTED
 
 __all__ = ["SupervisorConfig", "Supervisor", "WedgeDetector",
@@ -178,7 +179,8 @@ class WedgeDetector:
                         pass
                     return
 
-        thread = threading.Thread(target=_run, name=name, daemon=True)
+        thread = obs_threads.spawn(_run, name=name, daemon=True,
+                                   start=False)
         thread.stop = stop  # type: ignore[attr-defined]
         thread.start()
         return thread

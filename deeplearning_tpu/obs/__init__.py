@@ -19,6 +19,10 @@ Three pieces, one policy (README "Observability policy"):
   Prometheus text exposition (``/metrics`` on every replica via
   ``MetricsServer``) and a JSON snapshot. Same disabled-path budget
   as spans: the hot-path helpers are one ``is None`` check when off.
+- ``threads`` — the thread spawn registry: every background thread in
+  the runtime is created via ``threads.spawn(target, name=...)`` so
+  the concurrency linter (DLT204) and the strict-mode thread sanitizer
+  know every entry point. Stdlib-only.
 - ``fleet``  — scraper/aggregator over N replica ``/metrics``
   endpoints: rollups (summed QPS, max e2e p99, queue depth, replica
   status counts), SLO breach flight events, ``fleet.jsonl``
@@ -32,13 +36,13 @@ every ROADMAP on-chip calibration item consumes;
 fleet timeline.
 """
 
-from . import flight, metrics, spans, xla
+from . import flight, metrics, spans, threads, xla
 from .flight import FlightRecorder
 from .metrics import MetricsRegistry, MetricsServer
 from .spans import SpanTracer, span, step_span, traced
 from .xla import HbmWatermark, hbm_snapshot, tracked_compile
 
-__all__ = ["spans", "xla", "flight", "metrics", "SpanTracer", "span",
-           "step_span", "traced", "FlightRecorder", "HbmWatermark",
-           "hbm_snapshot", "tracked_compile", "MetricsRegistry",
-           "MetricsServer"]
+__all__ = ["spans", "xla", "flight", "metrics", "threads", "SpanTracer",
+           "span", "step_span", "traced", "FlightRecorder",
+           "HbmWatermark", "hbm_snapshot", "tracked_compile",
+           "MetricsRegistry", "MetricsServer"]

@@ -298,6 +298,23 @@ def _flight_record(kind: str, **data: Any) -> None:
     record(kind, **data)
 
 
+def _thread_registry():
+    """obs.threads resolvable under standalone file loads too (same
+    trick as ``metrics._thread_registry``): one registry per process."""
+    import sys
+    mod = sys.modules.get("deeplearning_tpu.obs.threads")
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "threads.py")
+        spec = importlib.util.spec_from_file_location(
+            "deeplearning_tpu.obs.threads", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
 def discover_endpoints(run_dir: str) -> List[str]:
     """Replica URLs advertised under a supervisor workdir: reads
     ``endpoint.json`` in the dir itself and in each ``replica-*/``
@@ -418,9 +435,8 @@ class FleetScraper:
     def start(self) -> "FleetScraper":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="fleet-scrape", daemon=True)
-            self._thread.start()
+            self._thread = _thread_registry().spawn(
+                self._run, name="fleet-scrape", daemon=True)
         return self
 
     def stop(self, timeout: float = 2.0) -> None:

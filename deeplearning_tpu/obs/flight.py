@@ -29,7 +29,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "get_recorder", "record", "configure",
-           "dump", "install_signal_handler"]
+           "dump", "install_signal_handler", "flush_pending"]
 
 
 def _jsonable(obj: Any, depth: int = 0) -> Any:
@@ -172,8 +172,34 @@ def dump(reason: str = "manual", *,
     return _RECORDER.dump(reason, exception=exception, path=path)
 
 
+_PENDING = threading.Event()
+
+
 def _sigterm_dump(signum: int, frame) -> None:
-    _RECORDER.dump("sigterm")
+    # Signal-handler discipline (DLT103): mark the dump pending and get
+    # out. When a graceful subscriber owns this signal the process
+    # keeps running to its next step boundary, where flush_pending()
+    # does the open()/json work on the normal call stack.
+    _PENDING.set()
+    from ..elastic import signals
+    if any(graceful for _fn, graceful
+           in signals.subscribers(signal.SIGTERM)):
+        return
+    # Terminating chain: no graceful owner means the pre-registry
+    # handler / OS default kills the process right after this handler
+    # returns — there IS no later flush point, so the unsafe dump here
+    # is the only dump. Justified, not fixed:
+    flush_pending()  # dltpu: allow(DLT103) terminating chain: last chance to write
+
+
+def flush_pending() -> Optional[str]:
+    """Write a dump the SIGTERM handler deferred; no-op when none is
+    pending. Called from the Trainer's step boundary (next to the
+    preemption poll) and from its graceful-exit path."""
+    if not _PENDING.is_set():
+        return None
+    _PENDING.clear()
+    return _RECORDER.dump("sigterm")
 
 
 def install_signal_handler() -> bool:
@@ -181,9 +207,10 @@ def install_signal_handler() -> bool:
     the elastic signal registry, so this hook COEXISTS with the
     preemption guard instead of silently replacing it: without a
     graceful subscriber the process still terminates after the dump
-    (pre-registry handler or OS default chained); with one, the trainer
-    checkpoints and exits at the next step boundary. Main thread only;
-    returns False when it isn't."""
+    (pre-registry handler or OS default chained); with one, the
+    handler only marks the dump pending and the trainer flushes it at
+    the next step boundary (``flush_pending``) before checkpointing
+    out. Main thread only; returns False when it isn't."""
     global _SIGNAL_INSTALLED
     if _SIGNAL_INSTALLED:
         return True

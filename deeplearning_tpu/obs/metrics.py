@@ -450,6 +450,25 @@ def read_endpoint(path: str) -> Optional[Dict[str, Any]]:
     return doc if isinstance(doc, dict) and doc.get("url") else None
 
 
+def _thread_registry():
+    """The obs.threads spawn registry, resolvable even when this module
+    was loaded standalone by file path (``tools/obs_report.py --check``):
+    load the adjacent ``threads.py`` under its canonical name so the
+    process still has exactly one registry."""
+    import sys
+    mod = sys.modules.get("deeplearning_tpu.obs.threads")
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "threads.py")
+        spec = importlib.util.spec_from_file_location(
+            "deeplearning_tpu.obs.threads", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
 # ------------------------------------------------------------ stats server
 class MetricsServer:
     """Opt-in stdlib scrape server: ``/metrics`` (text format),
@@ -525,10 +544,9 @@ class MetricsServer:
             (self.host, self._requested_port), self._handler_class())
         self.port = self._server.server_port
         self.url = f"http://{self.host}:{self.port}"
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="obs-metrics-http",
+        self._thread = _thread_registry().spawn(
+            self._server.serve_forever, name="obs-metrics-http",
             daemon=True)
-        self._thread.start()
         return self
 
     def stop(self, timeout: float = 2.0) -> None:

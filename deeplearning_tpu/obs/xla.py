@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import flight, metrics, spans
+from . import threads as obs_threads
 
 __all__ = ["tracked_compile", "compile_events", "compile_stats",
            "memory_analysis_dict", "hbm_snapshot", "HbmWatermark",
@@ -304,9 +305,8 @@ class HbmWatermark:
     def start(self) -> "HbmWatermark":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="obs-metrics", daemon=True)
-            self._thread.start()
+            self._thread = obs_threads.spawn(
+                self._run, name="obs-metrics", daemon=True)
         return self
 
     def stop(self, timeout: float = 2.0) -> None:

@@ -47,6 +47,7 @@ import jax
 import numpy as np
 
 from ..obs import flight
+from ..obs import threads as obs_threads
 from ..obs.spans import span
 from .admission import AdmissionController, DeadlineExceeded
 from .telemetry import ServeTelemetry
@@ -199,10 +200,8 @@ class MicroBatcher:
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._dispatch_loop, name="serve-dispatch",
-                daemon=True)
-            self._thread.start()
+            self._thread = obs_threads.spawn(
+                self._dispatch_loop, name="serve-dispatch", daemon=True)
 
     def close(self, timeout: float = 5.0) -> None:
         self._stop.set()

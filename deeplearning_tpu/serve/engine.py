@@ -252,7 +252,7 @@ class InferenceEngine:
                 self._executables[bucket] = tracked_compile(
                     lowered, f"serve/{self.name}/b{bucket}")
                 self.compile_count += 1
-        return self._executables[bucket]
+            return self._executables[bucket]
 
     def warmup(self) -> Dict[int, float]:
         """AOT-compile every bucket (persistent-cache-backed); returns

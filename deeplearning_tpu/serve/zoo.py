@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..obs import flight
 from ..obs import metrics as obs_metrics
+from ..obs import threads as obs_threads
 from .admission import AdmissionController, Rejected, TenantAdmission
 
 __all__ = ["ModelZoo", "ModelSpec"]
@@ -185,7 +186,11 @@ class ModelZoo:
             return None
 
     def touch(self, alias: str) -> None:
-        self._last_used[alias] = time.monotonic()
+        # under the (reentrant) lock: also written by loader threads
+        # via _install, and read by the eviction victim scan — an
+        # unguarded write here was the textbook DLT200
+        with self._lock:
+            self._last_used[alias] = time.monotonic()
 
     def mark_dispatch(self, alias: str, delta: int) -> None:
         """Dispatch-thread bracket around a running batch: an engine
@@ -223,7 +228,8 @@ class ModelZoo:
         state = self.request(alias)
         if not wait or state == "warm":
             return self.state(alias)
-        thread = self._load_threads.get(alias)
+        with self._lock:
+            thread = self._load_threads.get(alias)
         if thread is not None:
             thread.join(timeout_s)
         return self.state(alias)
@@ -233,8 +239,9 @@ class ModelZoo:
         if thread is not None and thread.is_alive():
             return
         self._state[alias] = "loading"
-        thread = threading.Thread(target=self._do_load, args=(alias,),
-                                  name=f"zoo-load-{alias}", daemon=True)
+        thread = obs_threads.spawn(self._do_load, args=(alias,),
+                                   name=f"zoo-load-{alias}",
+                                   daemon=True, start=False)
         self._load_threads[alias] = thread
         thread.start()
 

@@ -118,6 +118,10 @@ class Trainer:
         # for the whole run. None defers to DLTPU_STRICT in the env.
         self.strict_modes = strict_mod.resolve(strict)
         self.strict_sections = 0     # guard regions entered (test hook)
+        # "threads" arms the runtime thread sanitizer now, before the
+        # prefetcher/heartbeat/metrics objects construct their locks —
+        # enable() patches module threading attrs, so timing matters
+        strict_mod.maybe_enable_threads(self.strict_modes)
         # self-healing policy (README "Self-healing policy"): None/"abort"
         # keeps the seed behavior (abort_non_finite raises on the first
         # bad step); "rollback" (or a RecoveryPolicy / RecoveryManager)
@@ -408,6 +412,10 @@ class Trainer:
 
     def _check_preempted(self) -> None:
         """Step-boundary poll (one Event.is_set when armed)."""
+        # a SIGTERM handler defers its flight dump to here (the signal-
+        # handler-safety contract: no open()/json on the signal stack)
+        if self.obs_enabled:
+            flight.flush_pending()
         if self.preempt_guard is not None and \
                 self.preempt_guard.requested():
             raise Preempted(

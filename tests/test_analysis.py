@@ -469,7 +469,9 @@ class TestStrictMode:
         assert strict.resolve("nans") == frozenset({"nans"})
         both = frozenset({"transfers", "nans"})
         assert strict.resolve("transfers,nans") == both
-        assert strict.resolve("all") == both
+        assert strict.resolve("threads") == frozenset({"threads"})
+        assert strict.resolve("all") == frozenset(
+            {"transfers", "nans", "threads"})
         with pytest.raises(ValueError):
             strict.resolve("bogus")
 

@@ -317,6 +317,45 @@ class TestTrainerTraceAcceptance:
         assert flight.get_recorder().events("step") == []
 
 
+class TestSigtermDumpDeferral:
+    """ISSUE 13 satellite: with a graceful subscriber owning SIGTERM the
+    handler only MARKS the dump pending; the trainer's step boundary
+    (``flush_pending``) does the open()/json work on a normal call
+    stack. Without a graceful owner the chained default terminates the
+    process right after the handler, so it dumps in-handler — the last
+    chance to write."""
+
+    def test_deferred_to_flush_when_graceful_owner_present(
+            self, tmp_path):
+        import signal
+        from deeplearning_tpu.elastic import signals
+        target = tmp_path / "flightrec.json"
+        flight.configure(str(target))
+        flight.record("step", step=1)
+        graceful = lambda s, f: None                    # noqa: E731
+        assert signals.subscribe(signal.SIGTERM, graceful,
+                                 graceful=True)
+        try:
+            flight._sigterm_dump(signal.SIGTERM, None)
+            assert not target.exists()                  # deferred
+            out = flight.flush_pending()
+            assert out == str(target) and target.exists()
+            assert json.loads(target.read_text())["reason"] == "sigterm"
+            assert flight.flush_pending() is None       # one-shot
+        finally:
+            signals.unsubscribe(signal.SIGTERM, graceful)
+            flight._PENDING.clear()
+
+    def test_immediate_dump_without_graceful_owner(self, tmp_path):
+        import signal
+        target = tmp_path / "flightrec.json"
+        flight.configure(str(target))
+        flight.record("step", step=1)
+        flight._sigterm_dump(signal.SIGTERM, None)
+        assert target.exists()                          # no flush point
+        assert flight.flush_pending() is None
+
+
 class TestFlightDumpAcceptance:
     def test_divergence_dumps_flightrec_with_steps_and_config(
             self, tmp_path):

@@ -420,3 +420,65 @@ def test_zoo_three_model_e2e_compile_once_parity_evict_reload():
                               solo["fcn_a"].infer(images[0])[0])
     assert zoo.state("fcn_a") == "warm"
     assert zoo.loads == 4 and zoo.evictions == 1
+
+
+# --------------------------------------- load-vs-evict under threadsan
+class TestZooRaceUnderThreadSanitizer:
+    """ISSUE 13 satellite: hammer admin load against pressure eviction
+    over the same alias with ``DLTPU_STRICT=threads`` armed — any
+    lock-order inversion or discipline break between the zoo lock and
+    the spawn registry raises ``LockOrderError`` and fails here."""
+
+    def test_load_vs_evict_race_is_lock_clean(self, monkeypatch):
+        from deeplearning_tpu.analysis import strict as strict_mod
+        from deeplearning_tpu.analysis import threadsan
+        monkeypatch.setenv("DLTPU_STRICT", "threads")
+        threadsan.reset()
+        assert strict_mod.maybe_enable_threads(strict_mod.resolve())
+        try:
+            zoo = pressure_zoo(limit=1000, alert=0.9)
+            errors = []
+            deadline = time.monotonic() + 1.0
+
+            def hammer(step):
+                while time.monotonic() < deadline:
+                    try:
+                        step()
+                    except threadsan.LockOrderError as exc:
+                        errors.append(exc)
+                        return
+
+            def admin():
+                zoo.load("a", wait=True, timeout_s=5.0)
+                zoo.touch("a")
+                zoo.engine("a")
+
+            def pressure():
+                zoo.load("b", wait=True, timeout_s=5.0)
+                zoo.evict("a")
+                zoo.enforce_pressure()
+
+            workers = [threading.Thread(target=hammer, args=(fn,),
+                                        daemon=True)
+                       for fn in (admin, pressure)]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(15.0)
+            assert not any(t.is_alive() for t in workers), \
+                "race workers wedged (deadlock?)"
+            assert errors == [], errors[0].report
+            st = threadsan.status()
+            assert st["violations"] == 0
+            assert st["locks_instrumented"] > 0   # zoo lock WAS watched
+            # with the hammering done, no entry is half-flipped: every
+            # warm alias serves a real engine, everything else serves
+            # none
+            for alias in ("a", "b"):
+                if zoo.state(alias) == "warm":
+                    assert zoo.engine(alias) is not None
+                else:
+                    assert zoo.engine(alias) is None
+        finally:
+            threadsan.disable()
+            threadsan.reset()

@@ -1,20 +1,31 @@
 #!/usr/bin/env python
 """dltpu-check: the repo's policy gate.
 
-  python tools/check.py                    # lint, human-readable findings
+  python tools/check.py                    # lint + concurrency audit, all findings
   python tools/check.py --ci               # ratchet gate: exit 1 on NEW findings
   python tools/check.py --update-baseline  # re-record analysis/baseline.json
-  python tools/check.py --rules            # rule table
+  python tools/check.py --rules            # rule table (DLT1xx / DLT2xx groups)
   python tools/check.py --jaxpr            # structural audits (imports jax)
 
+Two static layers run in one pass: the TPU-policy linter
+(``analysis/lint.py``, DLT100-105) and the concurrency auditor
+(``analysis/concurrency.py``, DLT200-205 — lock discipline, lock-order
+deadlock cycles, thread-registry enforcement). They share one pragma
+syntax and one ratchet baseline, so the CI contract stays a single
+exit code.
+
 The default/``--ci``/``--update-baseline``/``--rules`` paths never
-import jax (``analysis/lint.py`` is loaded standalone by file path, not
-through the ``deeplearning_tpu`` package whose ``__init__`` pulls the
-whole stack) — the lint gate stays a sub-10s pure-CPython pass that CI
+import jax (both analysis modules are loaded standalone by file path,
+not through the ``deeplearning_tpu`` package whose ``__init__`` pulls
+the whole stack) — the gate stays a sub-3s pure-CPython pass that CI
 can run before any accelerator is even visible. ``--jaxpr`` traces the
 registered step/postprocess functions and checks their structural
 budgets (peak intermediate elements, transfer primitives), so it does
 import jax; run it with ``JAX_PLATFORMS=cpu`` off-device.
+
+``--json`` additionally emits the static lock-order graph edges
+(``lock_order_edges``) — the same edges ``analysis/threadsan.py``
+seeds its runtime check from.
 
 Exit codes: 0 clean, 1 policy findings, 2 usage/internal error.
 """
@@ -31,34 +42,60 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_lint():
-    """Import analysis/lint.py WITHOUT importing the package (which
+def _load_by_path(alias: str, filename: str):
+    """Import an analysis module WITHOUT importing the package (which
     would drag jax in). sys.modules registration is required: lint.py
     uses ``from __future__ import annotations`` + dataclasses, and
     dataclass field resolution looks the module up by name."""
-    path = os.path.join(_REPO, "deeplearning_tpu", "analysis", "lint.py")
-    spec = importlib.util.spec_from_file_location("_dltpu_lint", path)
+    path = os.path.join(_REPO, "deeplearning_tpu", "analysis", filename)
+    spec = importlib.util.spec_from_file_location(alias, path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod
     spec.loader.exec_module(mod)
     return mod
 
 
-def cmd_rules(lint) -> int:
-    width = max(len(r) for r in lint.RULES)
-    for rule, desc in sorted(lint.RULES.items()):
-        print(f"{rule:<{width}}  {desc}")
-    print(f"\nsuppress one site:   # dltpu: allow({min(lint.RULES)})")
+def _load_lint():
+    return _load_by_path("_dltpu_lint", "lint.py")
+
+
+def _load_conc():
+    # loaded after lint: concurrency.py reuses the registered alias
+    return _load_by_path("_dltpu_concurrency", "concurrency.py")
+
+
+def _stale_baseline(baseline: dict, root: str) -> list:
+    """Baseline entries whose file no longer exists under ``root`` —
+    dead weight that silently shrinks the ratchet's reach."""
+    return sorted(p for p in baseline.get("counts", {})
+                  if not os.path.exists(os.path.join(root, p)))
+
+
+def cmd_rules(lint, conc) -> int:
+    groups = (("TPU policy (DLT1xx) — analysis/lint.py", lint.RULES),
+              ("concurrency (DLT2xx) — analysis/concurrency.py",
+               conc.RULES))
+    width = max(len(r) for _t, rules in groups for r in rules)
+    for title, rules in groups:
+        print(f"{title}:")
+        for rule, desc in sorted(rules.items()):
+            print(f"  {rule:<{width}}  {desc}")
+        print()
+    print(f"suppress one site:   # dltpu: allow({min(lint.RULES)})")
     print("suppress all rules:  # dltpu: allow(*)")
     return 0
 
 
-def cmd_lint(lint, root: str, baseline_path: str, ci: bool,
+def cmd_lint(lint, conc, root: str, baseline_path: str, ci: bool,
              as_json: bool) -> int:
     t0 = time.monotonic()
     findings, n_files = lint.lint_tree(root)
+    conc_findings, _n2 = conc.lint_tree(root)
+    findings = sorted(findings + conc_findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
     baseline = lint.load_baseline(baseline_path)
     new = lint.new_findings(findings, baseline)
+    stale = _stale_baseline(baseline, root)
     dt = time.monotonic() - t0
     n_baselined = sum(sum(r.values())
                       for r in baseline.get("counts", {}).values())
@@ -66,11 +103,15 @@ def cmd_lint(lint, root: str, baseline_path: str, ci: bool,
     clean = not new
 
     if as_json:
+        graph = conc.lock_order_graph(root)
         print(json.dumps({
             "clean": clean, "files_scanned": n_files,
             "findings": [str(f) for f in findings],
             "baseline_findings": n_baselined,
             "new_groups": new, "new": n_new,
+            "stale_baseline": stale,
+            "lock_order_edges": graph["edges"],
+            "lock_order_cycles": graph["cycles"],
             "seconds": round(dt, 3),
         }, indent=2, sort_keys=True))
         return 0 if clean else 1
@@ -84,6 +125,9 @@ def cmd_lint(lint, root: str, baseline_path: str, ci: bool,
                   f"(baseline allows {grp['budget']}) — fix it, pragma it "
                   f"with '# dltpu: allow({grp['rule']})', or (for "
                   f"pre-existing debt only) rerun --update-baseline")
+        for p in stale:
+            print(f"warning: baseline entry for missing file {p} — "
+                  "run --update-baseline to prune it")
         verdict = "clean" if clean else f"{n_new} NEW finding(s)"
         print(f"dltpu-check: {verdict} — {len(findings)} total, "
               f"{n_baselined} baselined, {n_files} files, {dt:.2f}s")
@@ -97,8 +141,12 @@ def cmd_lint(lint, root: str, baseline_path: str, ci: bool,
     return 0 if clean else 1
 
 
-def cmd_update_baseline(lint, root: str, baseline_path: str) -> int:
+def cmd_update_baseline(lint, conc, root: str, baseline_path: str) -> int:
+    old = lint.load_baseline(baseline_path)
+    pruned = _stale_baseline(old, root)
     findings, n_files = lint.lint_tree(root)
+    conc_findings, _n2 = conc.lint_tree(root)
+    findings = findings + conc_findings
     lint.write_baseline(findings, baseline_path)
     by_rule = {}
     for f in findings:
@@ -107,6 +155,10 @@ def cmd_update_baseline(lint, root: str, baseline_path: str) -> int:
     print(f"wrote {os.path.relpath(baseline_path, root)}: "
           f"{len(findings)} finding(s) across {n_files} files"
           + (f" ({detail})" if detail else ""))
+    if pruned:
+        print(f"pruned {len(pruned)} stale entr"
+              f"{'y' if len(pruned) == 1 else 'ies'} for missing "
+              f"file(s): {', '.join(pruned)}")
     return 0
 
 
@@ -161,12 +213,13 @@ def main(argv=None) -> int:
         return cmd_jaxpr(args.json)
 
     lint = _load_lint()
+    conc = _load_conc()
     baseline = args.baseline or lint.DEFAULT_BASELINE
     if args.rules:
-        return cmd_rules(lint)
+        return cmd_rules(lint, conc)
     if args.update_baseline:
-        return cmd_update_baseline(lint, args.root, baseline)
-    return cmd_lint(lint, args.root, baseline, ci=args.ci,
+        return cmd_update_baseline(lint, conc, args.root, baseline)
+    return cmd_lint(lint, conc, args.root, baseline, ci=args.ci,
                     as_json=args.json)
 
 

@@ -189,7 +189,9 @@ def run_closed_loop(batcher, images, concurrency: int, n_requests: int,
                 if alias is not None:
                     sampler.tally(alias, "completed", lat)
 
-    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+    from deeplearning_tpu.obs import threads as obs_threads
+    threads = [obs_threads.spawn(worker, args=(w,), daemon=True,
+                                 name=f"loadgen-closed-{w}", start=False)
                for w in range(concurrency)]
     t0 = time.perf_counter()
     for t in threads:
@@ -250,10 +252,10 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
                 if alias is not None:
                     sampler.tally(alias, "completed", lat)
 
-    pool = [threading.Thread(target=resolver, daemon=True)
-            for _ in range(8)]
-    for t in pool:
-        t.start()
+    from deeplearning_tpu.obs import threads as obs_threads
+    pool = [obs_threads.spawn(resolver, daemon=True,
+                              name=f"loadgen-resolver-{i}")
+            for i in range(8)]
     period = 1.0 / rate_hz
     rng = np.random.default_rng(0)
     t_end = time.perf_counter() + duration_s

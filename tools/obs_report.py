@@ -334,27 +334,56 @@ def summarize(run_dir: str) -> Dict[str, Any]:
 
 def analysis_summary() -> Optional[Dict[str, Any]]:
     """dltpu-check posture: rules enabled + the committed baseline's
-    size. Reads ``analysis/baseline.json`` only — no tree scan, so the
-    report stays instant; run ``tools/check.py --ci`` for a verdict."""
-    lint_py = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "deeplearning_tpu", "analysis",
-        "lint.py")
+    size, and (v2) the concurrency surface — registered spawn sites,
+    locks in the static order graph, DLT2xx baseline debt. The lint
+    half reads ``analysis/baseline.json`` only; the concurrency half
+    parses just the thread/lock files (sub-second); run
+    ``tools/check.py --ci`` for a verdict."""
+    analysis_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning_tpu", "analysis")
+    lint_py = os.path.join(analysis_dir, "lint.py")
     if not os.path.exists(lint_py):
         return None
     import importlib.util
-    spec = importlib.util.spec_from_file_location("_dltpu_lint_report",
-                                                  lint_py)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod   # dataclasses resolve via sys.modules
-    spec.loader.exec_module(mod)
+
+    def load(alias: str, path: str):
+        spec = importlib.util.spec_from_file_location(alias, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod  # dataclasses resolve via sys.modules
+        spec.loader.exec_module(mod)
+        return mod
+
+    mod = load("_dltpu_lint_report", lint_py)
     baseline = mod.load_baseline()
     b_counts = baseline.get("counts", {})
-    return {
+
+    def rule_total(prefix: str) -> int:
+        return sum(n for rules in b_counts.values()
+                   for rule, n in rules.items()
+                   if rule.startswith(prefix))
+
+    out = {
         "rules": len(mod.RULES),
         "baseline_findings": sum(sum(r.values())
                                  for r in b_counts.values()),
         "baseline_files": len(b_counts),
     }
+    conc_py = os.path.join(analysis_dir, "concurrency.py")
+    if os.path.exists(conc_py):
+        try:
+            conc = load("_dltpu_concurrency_report", conc_py)
+            graph = conc.lock_order_graph()
+            out["concurrency"] = {
+                "rules": len(conc.RULES),
+                "spawn_sites": len(graph["spawn_sites"]),
+                "locks": len(graph["locks"]),
+                "lock_order_edges": len(graph["edges"]),
+                "lock_order_cycles": len(graph["cycles"]),
+                "baseline_findings": rule_total("DLT2"),
+            }
+        except Exception:  # noqa: BLE001 - posture is best-effort
+            out["concurrency"] = {"error": "concurrency scan failed"}
+    return out
 
 
 def restart_summary(sup: Optional[Dict[str, Any]],
@@ -613,6 +642,16 @@ def render(summary: Dict[str, Any]) -> str:
             f"analysis: {a['rules']} DLT rules enabled, baseline "
             f"{a['baseline_findings']} finding(s) in "
             f"{a['baseline_files']} file(s) (tools/check.py --ci)")
+        c = a.get("concurrency")
+        if c and "error" not in c:
+            lines.append(
+                f"concurrency: {c['rules']} DLT2xx rules, "
+                f"{c['spawn_sites']} spawn site(s) registered, "
+                f"{c['locks']} lock(s) in the static order graph "
+                f"({c['lock_order_edges']} edge(s), "
+                f"{c['lock_order_cycles']} cycle(s)), baseline "
+                f"{c['baseline_findings']} finding(s) "
+                f"(DLTPU_STRICT=threads arms the runtime sanitizer)")
     return "\n".join(lines)
 
 
@@ -856,6 +895,15 @@ def _check() -> int:
         assert ana["baseline_findings"] >= 0, ana
         assert "analysis: " in report and "DLT rules enabled" in report, \
             report
+        # dltpu-check v2 concurrency posture: the thread fleet is
+        # visible (spawn sites registered, locks graphed, no cycles)
+        con = ana["concurrency"]
+        assert con["rules"] == 6, con
+        assert con["spawn_sites"] > 0, con
+        assert con["locks"] > 0, con
+        assert con["lock_order_cycles"] == 0, con
+        assert "concurrency: " in report and \
+            "spawn site(s) registered" in report, report
     print("obs_report --check: ok")
     return 0
 

@@ -466,9 +466,14 @@ def main(argv=None) -> int:
     if args.zoo is not None and args.http is None:
         ap.error("--zoo requires --http (stdin mode is single-model)")
 
+    from deeplearning_tpu.analysis import strict as strict_mod
     from deeplearning_tpu.elastic import heartbeat as hb
     from deeplearning_tpu.obs import spans
     from deeplearning_tpu.serve import InferenceEngine, MicroBatcher
+
+    # DLTPU_STRICT=threads: instrument the fleet's locks BEFORE the
+    # zoo/batcher/heartbeat objects below create them
+    strict_mod.maybe_enable_threads(strict_mod.resolve())
 
     # DLTPU_TRACE=1: record the span timeline and dump trace.json on
     # graceful exit (next to the endpoint file when supervised, so
@@ -526,12 +531,13 @@ def main(argv=None) -> int:
                 # returns, the trace dumps, the heartbeat finalizes —
                 # instead of the default die-mid-request
                 import signal
-                import threading
+
+                from deeplearning_tpu.obs import threads as obs_threads
 
                 def _drain(signum, frame):
-                    threading.Thread(target=server.shutdown,
-                                     name="serve-drain",
-                                     daemon=True).start()
+                    obs_threads.spawn(server.shutdown,
+                                      name="serve-drain",
+                                      daemon=True)
                 try:
                     signal.signal(signal.SIGTERM, _drain)
                 except ValueError:

@@ -96,7 +96,7 @@ def main(argv=None) -> int:
     if args.replicas == 1 and args.run_id is None:
         return Supervisor(build_cfg(args.workdir, None, None)).run()
 
-    import threading
+    from deeplearning_tpu.obs import threads as obs_threads
 
     run_id = args.run_id or f"run-{uuid.uuid4().hex[:8]}"
     print(f"[supervise] fleet run_id={run_id} "
@@ -114,8 +114,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             rcs[i] = 1
 
-    threads = [threading.Thread(target=_one, args=(i,),
-                                name=f"supervise-{i}")
+    # non-daemon on purpose: the fleet result is the join below (DLT203)
+    threads = [obs_threads.spawn(_one, args=(i,),
+                                 name=f"supervise-{i}",
+                                 daemon=False, start=False)
                for i in range(args.replicas)]
     for t in threads:
         t.start()
