@@ -369,6 +369,46 @@ def _concurrency_status():
     }
 
 
+def _controller_status():
+    """Fleet-controller policy smoke (host-only, no device work): the
+    three hysteresis properties every capacity decision rests on —
+    sustained breach scales up, sustained idle scales down, cooldown
+    stops flapping — exercised through the real FleetPolicy with a
+    synthetic clock."""
+    from deeplearning_tpu.fleet import FleetPolicy
+
+    t0 = time.perf_counter()
+
+    def rollup(p99, queue=0.0, qps=0.0):
+        return {"e2e_ms_p99_max": p99, "queue_depth_total": queue,
+                "qps_total": qps, "error_rate": 0.0,
+                "delta": {"dt_s": 1.0, "requests_total": qps,
+                          "rejected_total": 0.0, "timed_out_total": 0.0}}
+
+    hot = FleetPolicy(min_replicas=1, max_replicas=4,
+                      p99_budget_ms=100.0, breach_polls=3,
+                      idle_polls=3, cooldown_s=30.0)
+    acts = [hot.observe(rollup(500.0, queue=40.0, qps=50.0), 2,
+                        now=float(i)).action for i in range(6)]
+    scale_up_ok = acts[:3] == ["hold", "hold", "scale_up"]
+    no_flap_ok = acts[3:] == ["hold"] * 3   # cooldown holds the line
+
+    calm = FleetPolicy(min_replicas=1, max_replicas=4,
+                       p99_budget_ms=100.0, breach_polls=3,
+                       idle_polls=3, cooldown_s=30.0)
+    downs = [calm.observe(rollup(1.0), 2, now=float(i)).action
+             for i in range(3)]
+    scale_down_ok = downs == ["hold", "hold", "scale_down"]
+
+    return {
+        "clean": scale_up_ok and scale_down_ok and no_flap_ok,
+        "scale_up_ok": scale_up_ok,
+        "scale_down_ok": scale_down_ok,
+        "no_flap_ok": no_flap_ok,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _lint_status():
     """dltpu-check ratchet verdict for the bench record: a perf number
     from a tree with NEW policy findings (a stray hot-loop sync, a
@@ -457,6 +497,11 @@ def _health_probe():
             cpu_fallback["concurrency_clean"] = _concurrency_status()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["concurrency_clean"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["controller_clean"] = _controller_status()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["controller_clean"] = {"error": repr(e)}
         progress[0] += 1
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
@@ -606,6 +651,11 @@ def main():
         rec["concurrency_clean"] = _concurrency_status()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["concurrency_clean"] = {"error": repr(e)}
+    try:
+        # fleet-controller hysteresis smoke: scale decisions behave
+        rec["controller_clean"] = _controller_status()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["controller_clean"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

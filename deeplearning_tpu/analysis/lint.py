@@ -79,6 +79,7 @@ HOT_PATH_MODULES: Tuple[str, ...] = (
     # they must be provably sync-free too (stdlib-only by construction)
     "deeplearning_tpu/obs/metrics.py",
     "deeplearning_tpu/obs/fleet.py",
+    "deeplearning_tpu/fleet/",
 )
 
 # scan roots for lint_tree, relative to the repo root (tests/ is out by

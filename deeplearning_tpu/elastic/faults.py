@@ -43,6 +43,21 @@ to end instead of shortcut into:
   commits, the Trainer garbles the step dir on disk
   (:func:`corrupt_checkpoint`), so restore-time verification must fall
   back to the previous intact step.
+
+The fleet-choreography kinds target ONE replica of a supervised fleet
+(``DLTPU_REPLICA``, exported per child by ``tools/supervise.py``) so a
+single ``DLTPU_FAULTS`` value shared by every replica still wedges or
+preempts exactly one of them:
+
+- ``wedge_replica:<i>@step:N``: consumed by the serving
+  ``MicroBatcher``'s dispatch loop on replica ``i`` once ``dispatched``
+  reaches N — the loop blocks (heartbeat thread stays alive, queue
+  keeps filling) so ``DispatchWatch``/the controller must classify the
+  frozen stream and requeue the replica.
+- ``preempt_replica:<i>@step:N``: consumed on replica ``i`` at the same
+  site; the serving CLI reacts exactly as a real SIGTERM-with-grace
+  preemption would — drain, then exit 75 — so the controller's
+  preemption-as-capacity path runs for real.
 """
 
 from __future__ import annotations
@@ -52,19 +67,26 @@ import signal
 import time
 from typing import List, Optional
 
-__all__ = ["ENV_VAR", "ATTEMPT_VAR", "FaultSpec", "InjectedCrash",
-           "InjectedBadSample", "parse_faults", "active_faults",
-           "maybe_fire", "consume", "corrupt_checkpoint", "reset"]
+__all__ = ["ENV_VAR", "ATTEMPT_VAR", "REPLICA_VAR", "FaultSpec",
+           "InjectedCrash", "InjectedBadSample", "parse_faults",
+           "active_faults", "maybe_fire", "consume",
+           "corrupt_checkpoint", "reset"]
 
 ENV_VAR = "DLTPU_FAULTS"
 ATTEMPT_VAR = "DLTPU_RESTART_ATTEMPT"
 
 _KINDS = ("sigterm", "sigint", "crash", "wedge",
-          "nan", "bad_sample", "ckpt_corrupt")
+          "nan", "bad_sample", "ckpt_corrupt",
+          "wedge_replica", "preempt_replica")
 # kinds applied by their owning subsystem via consume(); maybe_fire
 # skips them so the generic step/checkpoint hooks can't double-deliver
-_CONSUMED_KINDS = ("nan", "bad_sample", "ckpt_corrupt")
+_CONSUMED_KINDS = ("nan", "bad_sample", "ckpt_corrupt",
+                   "wedge_replica", "preempt_replica")
+# kinds whose token carries a target replica index (kind:<i>) matched
+# against DLTPU_REPLICA — one shared fault var, one afflicted replica
+_REPLICA_KINDS = ("wedge_replica", "preempt_replica")
 _SITES = ("step", "checkpoint")
+REPLICA_VAR = "DLTPU_REPLICA"
 
 # long enough that only the supervisor's wedge kill ends it, short
 # enough that an escaped sleep can't outlive a test suite timeout
@@ -82,18 +104,21 @@ class InjectedBadSample(ValueError):
 
 
 class FaultSpec:
-    __slots__ = ("kind", "site", "at_step", "attempt", "fired")
+    __slots__ = ("kind", "site", "at_step", "attempt", "replica", "fired")
 
     def __init__(self, kind: str, site: str, at_step: Optional[int],
-                 attempt: Optional[int]):
+                 attempt: Optional[int], replica: Optional[int] = None):
         self.kind = kind
         self.site = site
         self.at_step = at_step
         self.attempt = attempt
+        self.replica = replica
         self.fired = False
 
     def __repr__(self) -> str:  # shows up in flight events / test output
-        parts = [self.kind, self.site if self.at_step is None
+        kind = (self.kind if self.replica is None
+                else f"{self.kind}:{self.replica}")
+        parts = [kind, self.site if self.at_step is None
                  else f"{self.site}:{self.at_step}"]
         if self.attempt is not None:
             parts.append(f"attempt:{self.attempt}")
@@ -105,6 +130,8 @@ class FaultSpec:
         if self.attempt is not None and self.attempt != attempt:
             return False
         if self.at_step is not None and step < self.at_step:
+            return False
+        if self.replica is not None and self.replica != _current_replica():
             return False
         return True
 
@@ -118,9 +145,17 @@ def parse_faults(text: str) -> List[FaultSpec]:
         if not raw:
             continue
         fields = [f.strip() for f in raw.split("@")]
-        kind = fields[0].lower()
+        kind, _, target = fields[0].lower().partition(":")
         if kind not in _KINDS:
             continue
+        replica = None
+        if kind in _REPLICA_KINDS:
+            try:
+                replica = int(target)
+            except ValueError:
+                continue               # replica kinds require a target
+        elif target:
+            continue                   # "sigterm:3" is not grammar
         site, at_step, attempt = "step", None, None
         ok = True
         for field in fields[1:]:
@@ -141,7 +176,7 @@ def parse_faults(text: str) -> List[FaultSpec]:
             else:
                 ok = False
         if ok:
-            specs.append(FaultSpec(kind, site, at_step, attempt))
+            specs.append(FaultSpec(kind, site, at_step, attempt, replica))
     return specs
 
 
@@ -164,6 +199,13 @@ def reset() -> None:
 def current_attempt() -> int:
     try:
         return int(os.environ.get(ATTEMPT_VAR, "0"))
+    except ValueError:
+        return 0
+
+
+def _current_replica() -> int:
+    try:
+        return int(os.environ.get(REPLICA_VAR, "0"))
     except ValueError:
         return 0
 

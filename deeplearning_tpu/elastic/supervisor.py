@@ -45,7 +45,34 @@ from ..obs import threads as obs_threads
 from .preempt import EXIT_PREEMPTED
 
 __all__ = ["SupervisorConfig", "Supervisor", "WedgeDetector",
-           "backoff_delay", "backoff_schedule"]
+           "backoff_delay", "backoff_schedule",
+           "worst_outcome", "exit_for_outcome",
+           "OUTCOME_SEVERITY", "EXIT_WEDGED"]
+
+# fleet exit classification: a crash outranks a wedge outranks a
+# preemption outranks a clean/deliberate stop — numeric exit codes
+# don't sort this way (75 > 1), so fleet mode classifies instead of
+# max()ing raw return codes
+OUTCOME_SEVERITY = {"completed": 0, "stopped": 0,
+                    "preempted": 1, "wedged": 2, "crashed": 3}
+EXIT_WEDGED = 70          # EX_SOFTWARE: killed-wedged, distinct from 1/75
+
+
+def worst_outcome(outcomes: Sequence[str]) -> str:
+    """The most severe outcome of a fleet (crash > wedge > preempted >
+    clean); unknown labels rank as crashes."""
+    worst = "completed"
+    for o in outcomes:
+        if OUTCOME_SEVERITY.get(o, 3) > OUTCOME_SEVERITY.get(worst, 3):
+            worst = o
+    return worst
+
+
+def exit_for_outcome(outcome: str) -> int:
+    """Representative process exit code for a classified outcome."""
+    return {"completed": 0, "stopped": 0,
+            "preempted": EXIT_PREEMPTED,
+            "wedged": EXIT_WEDGED}.get(outcome, 1)
 
 
 class SupervisorConfig:
@@ -204,8 +231,43 @@ class Supervisor:
                     "backoff_max_s": cfg.backoff_max_s})
         self.launches = 0
         self.outcomes: List[str] = []
+        self.final_outcome: Optional[str] = None
         self.backoff_total_s = 0.0
         self._log = print
+        # runtime lifecycle verbs (fleet controller surface): a pending
+        # directive is honored at the next watch poll / backoff wake —
+        # "stop" ends the run cleanly, "restart" requeues the child NOW
+        # without burning the restart budget (a capacity op, not a
+        # failure). on_outcome, when set, sees every natural ending and
+        # may return "requeue_now" (skip backoff + budget) or "stop"
+        # (shed the replica) to override the default policy.
+        self._directive_lock = threading.Lock()
+        self._directive: Optional[tuple] = None
+        self._wake = threading.Event()
+        self.on_outcome: Optional[Callable[..., Optional[str]]] = None
+
+    # ------------------------------------------------------- directives
+    def request_stop(self, reason: str = "requested") -> None:
+        """Ask the run loop to kill the child (if any) and return 0."""
+        with self._directive_lock:
+            self._directive = ("stop", reason)
+        self._wake.set()
+
+    def request_restart(self, reason: str = "requested") -> None:
+        """Ask the run loop to kill + relaunch the child immediately —
+        no backoff, no restart-budget burn. The relaunch still gets a
+        fresh attempt number (``DLTPU_RESTART_ATTEMPT``), so
+        attempt-gated fault specs don't re-fire in the replacement."""
+        with self._directive_lock:
+            if self._directive is None:       # stop always wins
+                self._directive = ("restart", reason)
+        self._wake.set()
+
+    def _take_directive(self) -> Optional[tuple]:
+        with self._directive_lock:
+            d, self._directive = self._directive, None
+            self._wake.clear()        # inside the lock: a set() after
+        return d                      # this re-raises the flag
 
     # ----------------------------------------------------------- pieces
     def _child_env(self, attempt: int) -> Dict[str, str]:
@@ -235,12 +297,19 @@ class Supervisor:
         return subprocess.Popen(self.cfg.argv, env=self._child_env(attempt))
 
     def _watch(self, child: subprocess.Popen) -> str:
-        """Block until the child exits or wedges. Returns ``"exit"`` or
-        ``"wedged"`` (child still running, caller must kill)."""
+        """Block until the child exits, wedges, or a lifecycle directive
+        arrives. Returns ``"exit"``, ``"wedged"``, or ``"directive"``
+        (for the latter two the child may still be running — caller must
+        kill). The directive check comes FIRST so a controller's verdict
+        beats the child's own exit classification: a wedged serving
+        child killed by us exits 0 through its graceful SIGTERM drain,
+        and that must still count as a requeue, not a completion."""
         detector = WedgeDetector(self.cfg.wedge_deadline_s)
         started = time.monotonic()
         seen_beat = False
         while True:
+            if self._directive is not None:
+                return "directive"
             if child.poll() is not None:
                 return "exit"
             beat = heartbeat.read_heartbeat(self.cfg.heartbeat_path)
@@ -252,7 +321,7 @@ class Supervisor:
             elif not seen_beat and (time.monotonic() - started
                                     >= self.cfg.startup_deadline_s):
                 return "wedged"           # never even produced a beat
-            time.sleep(self.cfg.poll_s)
+            self._wake.wait(self.cfg.poll_s)
 
     def _kill(self, child: subprocess.Popen) -> None:
         """SIGTERM → grace → SIGKILL. The grace window lets the child's
@@ -269,11 +338,37 @@ class Supervisor:
             child.wait()
 
     # -------------------------------------------------------------- run
+    def _finish(self, outcome: str, rc: int, reason: str) -> int:
+        self.final_outcome = outcome
+        self.flight.record(outcome if outcome in ("completed", "stopped")
+                           else "gave_up", returncode=rc, reason=reason)
+        self.flight.dump(reason, include_hbm=False)
+        return rc
+
     def run(self) -> int:
-        attempt, last_rc = 0, 1
+        attempt, last_rc, budget_used = 0, 1, 0
         while True:
             child = self._launch(attempt)
             verdict = self._watch(child)
+            if verdict == "directive":
+                kind, reason = self._take_directive() or ("stop", "race")
+                self._kill(child)
+                if kind == "stop":
+                    self.outcomes.append("stopped")
+                    self._log(f"[supervise] attempt {attempt}: stopped "
+                              f"({reason})", file=sys.stderr)
+                    return self._finish("stopped", 0, reason)
+                # restart directive: a capacity op — requeue NOW, no
+                # backoff, no budget burn; attempt still advances so the
+                # replacement's env (DLTPU_RESTART_ATTEMPT) moves past
+                # attempt-gated fault specs
+                self.outcomes.append("requeued")
+                self.flight.record("requeue", attempt=attempt,
+                                   reason=reason)
+                self._log(f"[supervise] attempt {attempt}: requeued "
+                          f"({reason})", file=sys.stderr)
+                attempt += 1
+                continue
             if verdict == "wedged":
                 self.flight.record("wedge_kill", attempt=attempt,
                                    pid=child.pid,
@@ -295,12 +390,33 @@ class Supervisor:
                 self.flight.record("child_exit", attempt=attempt,
                                    returncode=rc, outcome=outcome)
             self.outcomes.append(outcome)
+            hint = None
+            if self.on_outcome is not None:
+                try:
+                    hint = self.on_outcome(self, outcome, attempt, last_rc)
+                except Exception:  # noqa: BLE001 - policy must not kill us
+                    hint = None
+            if hint == "stop":
+                # the controller chose to shed this replica (e.g. a
+                # preemption while over capacity): a deliberate, clean end
+                self._log(f"[supervise] attempt {attempt} {outcome}; "
+                          f"shed by controller", file=sys.stderr)
+                return self._finish("stopped", 0, f"shed_after_{outcome}")
             if outcome == "completed":
                 self.flight.record("completed", attempt=attempt)
+                self.final_outcome = "completed"
                 self.flight.dump("completed", include_hbm=False)
                 return 0
             attempt += 1
-            if attempt > self.cfg.max_restarts:
+            if hint == "requeue_now":
+                self.flight.record("requeue", attempt=attempt - 1,
+                                   reason=f"controller_{outcome}")
+                self._log(f"[supervise] attempt {attempt - 1} {outcome}; "
+                          f"controller requeue now", file=sys.stderr)
+                continue
+            budget_used += 1
+            if budget_used > self.cfg.max_restarts:
+                self.final_outcome = outcome
                 self.flight.record("gave_up", attempts=attempt,
                                    last_outcome=outcome, returncode=last_rc)
                 self.flight.dump("gave_up", include_hbm=False)
@@ -308,11 +424,16 @@ class Supervisor:
                           f"{attempt} attempts; giving up (rc={last_rc})",
                           file=sys.stderr)
                 return last_rc if last_rc else 1
-            delay = backoff_delay(attempt, self.cfg, self.rng)
+            delay = backoff_delay(budget_used, self.cfg, self.rng)
             self.backoff_total_s += delay
             self.flight.record("backoff", attempt=attempt,
                                outcome=outcome, delay_s=round(delay, 3))
             self._log(f"[supervise] attempt {attempt - 1} {outcome}; "
                       f"requeue {attempt}/{self.cfg.max_restarts} in "
                       f"{delay:.2f}s", file=sys.stderr)
-            time.sleep(delay)
+            if self._wake.wait(delay):
+                d = self._take_directive()
+                if d is not None and d[0] == "stop":
+                    self.outcomes.append("stopped")
+                    return self._finish("stopped", 0, d[1])
+                # restart directive mid-backoff: just relaunch now

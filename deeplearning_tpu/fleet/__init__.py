@@ -1,0 +1,24 @@
+"""Fleet control plane: close the sense→decide→act loop over replicas.
+
+- :mod:`.policy` — :class:`FleetPolicy`: EWMA-smoothed hysteresis
+  autoscaling verdicts (pure; unit-testable).
+- :mod:`.replicaset` — :class:`ReplicaSet`: N supervised children as a
+  resizable collection with runtime lifecycle verbs.
+- :mod:`.controller` — :class:`FleetController`: the ticking loop that
+  scrapes, heals wedged replicas (drain → requeue), autoscales, and
+  treats preemption as a capacity event.
+- :mod:`.router` — :class:`FleetRouter`: the client-side front queue
+  that stops routing to draining/wedged replicas.
+
+Host-only modules (DLT100 hot-path covered): the control plane never
+performs device work or syncs — a controller that can wedge in the
+same device call it polices is no controller at all.
+"""
+
+from .controller import CONTROLLER_FLIGHT_FILE, FleetController
+from .policy import Decision, FleetPolicy
+from .replicaset import ReplicaSet
+from .router import FleetRouter
+
+__all__ = ["FleetPolicy", "Decision", "ReplicaSet", "FleetController",
+           "FleetRouter", "CONTROLLER_FLIGHT_FILE"]

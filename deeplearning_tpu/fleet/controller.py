@@ -1,0 +1,283 @@
+"""Fleet controller: the closed actuation loop over a replica set.
+
+PR 11 built the sensing half (``obs/fleet.py`` scrape → rollup →
+``slo_breach`` events); this is the half that ACTS. Each tick:
+
+1. **Sense** — rediscover live endpoints (``discover_endpoints`` with
+   ``live_only=True``: dead replicas' stale adverts are not capacity),
+   scrape the fleet, fold the rollup + counter deltas.
+2. **Heal** — a replica whose ``/healthz`` reports ``wedged`` is
+   drained (``POST /admin/drain`` → routers stop sending; queued work
+   gets the drain deadline to flush — a truly frozen dispatch stream
+   never flushes, which is fine) and then requeued through its
+   supervisor's ``request_restart`` directive: kill, relaunch, no
+   restart-budget burn, because the controller — not the child — chose
+   this death.
+3. **Decide** — feed the rollup to the :class:`~.policy.FleetPolicy`;
+   ``scale_up`` spawns a fresh replica, ``scale_down`` drains the
+   highest-index live one and stops it once drained (or the deadline
+   passes).
+
+Preemption (exit 75) short-circuits the cadence: the supervisor's
+``on_outcome`` hook calls :meth:`note_preemption` synchronously and the
+policy answers replace-or-shed immediately — ``"requeue_now"`` skips
+the backoff curve entirely, ``"stop"`` folds the capacity.
+
+Every decision lands twice: in the controller's own flight ring
+(dumped to ``<run_dir>/flightrec_controller.json`` — the file
+``tools/obs_report.py`` renders the fleet-controller section from) and
+in the process-global ring next to the ``slo_breach`` triggers, so
+cause and action interleave in one timeline. Events: ``fleet_scale``,
+``fleet_drain``, ``fleet_requeue``, ``preempt_capacity``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..obs import threads as obs_threads
+from ..obs.fleet import (FleetScraper, SLOPolicy, discover_endpoints,
+                         record_fleet_event)
+from ..obs.flight import FlightRecorder
+from .policy import FleetPolicy
+from .replicaset import ReplicaSet
+
+__all__ = ["FleetController", "CONTROLLER_FLIGHT_FILE"]
+
+CONTROLLER_FLIGHT_FILE = "flightrec_controller.json"
+
+
+def _post_json(url: str, timeout_s: float) -> Optional[Dict[str, Any]]:
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except (OSError, ValueError, urllib.error.URLError):
+        return None
+
+
+class FleetController:
+    """Ticks the sense→heal→decide loop. ``tick()`` is the synchronous
+    unit of work (tests drive it directly); ``start()`` runs it on
+    ``interval_s`` from a registered ``fleet-controller`` thread."""
+
+    def __init__(self, replica_set: ReplicaSet, policy: FleetPolicy, *,
+                 run_dir: str,
+                 slo: Optional[SLOPolicy] = None,
+                 interval_s: float = 1.0,
+                 drain_deadline_s: float = 10.0,
+                 scrape_timeout_s: float = 2.0,
+                 fleet_path: Optional[str] = None):
+        self.replica_set = replica_set
+        self.policy = policy
+        self.run_dir = os.path.abspath(run_dir)
+        self.interval_s = max(float(interval_s), 0.05)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.scraper = FleetScraper(
+            [], slo=slo, timeout_s=scrape_timeout_s,
+            fleet_path=(fleet_path if fleet_path is not None
+                        else os.path.join(self.run_dir, "fleet.jsonl")))
+        self.flight = FlightRecorder()
+        self.flight.configure(
+            os.path.join(self.run_dir, CONTROLLER_FLIGHT_FILE),
+            config={"policy": policy.snapshot(),
+                    "interval_s": self.interval_s,
+                    "drain_deadline_s": self.drain_deadline_s})
+        self.ticks = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.drains = 0
+        self.requeues = 0
+        self.preemptions = 0
+        # replicas mid-drain: index -> {"url", "t0", "then"} where
+        # "then" is what happens when drained/deadline: restart | stop
+        self._draining: Dict[int, Dict[str, Any]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # wire preemption-as-capacity into every member's supervisor
+        replica_set.on_outcome = self._on_outcome
+
+    # --------------------------------------------------------- record
+    def _record(self, kind: str, **data: Any) -> None:
+        self.flight.record(kind, **data)
+        record_fleet_event(kind, **data)    # global ring: one timeline
+        # actuations are rare and the ring is small: dump after each so
+        # the decision history survives even an ungraceful controller
+        # death (obs_report renders from this file)
+        self.flight.dump(kind, include_hbm=False)
+
+    # ------------------------------------------------------------ tick
+    def tick(self) -> Dict[str, Any]:
+        """One sense→heal→decide pass; returns the rollup it acted on."""
+        self.ticks += 1
+        self.scraper.endpoints = discover_endpoints(
+            self.run_dir, live_only=True)
+        rollup = self.scraper.scrape_once()
+        per_replica = rollup.get("per_replica") or []
+        self._heal(per_replica)
+        self._finish_drains()
+        # routable capacity: live supervisor slots minus mid-drain ones
+        live = len([i for i in self.replica_set.live()
+                    if i not in self._draining])
+        decision = self.policy.observe(rollup, live)
+        if decision.action == "scale_up":
+            index = self.replica_set.spawn()
+            self.scale_ups += 1
+            self._record("fleet_scale", direction="up", replica=index,
+                         reason=decision.reason, live=live,
+                         **_sig(decision))
+        elif decision.action == "scale_down":
+            victim = self._pick_victim(per_replica)
+            if victim is not None:
+                self._begin_drain(victim[0], victim[1], then="stop",
+                                  reason=decision.reason)
+                self.scale_downs += 1
+                self._record("fleet_scale", direction="down",
+                             replica=victim[0], reason=decision.reason,
+                             live=live, **_sig(decision))
+        return rollup
+
+    # ------------------------------------------------------------ heal
+    def _heal(self, per_replica: List[Dict[str, Any]]) -> None:
+        for row in per_replica:
+            if row.get("status") != "wedged":
+                continue
+            index = _replica_index(row)
+            if index is None or index in self._draining:
+                continue
+            self._begin_drain(index, row.get("url"), then="restart",
+                              reason="wedged")
+
+    def _begin_drain(self, index: int, url: Optional[str], *,
+                     then: str, reason: str) -> None:
+        if url:
+            _post_json(url.rstrip("/") + "/admin/drain",
+                       self.scrape_timeout_s)
+        self._draining[index] = {"url": url, "t0": time.monotonic(),
+                                 "then": then, "reason": reason}
+        self.drains += 1
+        self._record("fleet_drain", replica=index, reason=reason,
+                     then=then, deadline_s=self.drain_deadline_s)
+
+    def _finish_drains(self) -> None:
+        now = time.monotonic()
+        for index, state in list(self._draining.items()):
+            drained = False
+            url = state["url"]
+            if url:
+                doc = _post_json(url.rstrip("/") + "/admin/drain",
+                                 self.scrape_timeout_s)
+                drained = bool(doc and doc.get("drained"))
+            expired = now - state["t0"] >= self.drain_deadline_s
+            if not (drained or expired):
+                continue
+            del self._draining[index]
+            if state["then"] == "stop":
+                self.replica_set.stop(index, reason=state["reason"])
+                self._record("fleet_stop", replica=index,
+                             reason=state["reason"], drained=drained)
+            else:
+                self.replica_set.restart(index, reason=state["reason"])
+                self.requeues += 1
+                self._record("fleet_requeue", replica=index,
+                             reason=state["reason"], drained=drained,
+                             waited_s=round(now - state["t0"], 3))
+
+    def _pick_victim(self, per_replica: List[Dict[str, Any]]
+                     ) -> Optional[tuple]:
+        """Highest-index live replica not already draining, with its
+        URL when the scrape knows it — newest capacity goes first, the
+        original floor replicas go last."""
+        urls = {}
+        for row in per_replica:
+            i = _replica_index(row)
+            if i is not None:
+                urls[i] = row.get("url")
+        candidates = [i for i in self.replica_set.live()
+                      if i not in self._draining]
+        if not candidates:
+            return None
+        victim = max(candidates)
+        return victim, urls.get(victim)
+
+    # ------------------------------------------------- preemption hook
+    def _on_outcome(self, index: int, sup, outcome: str, attempt: int,
+                    rc: int) -> Optional[str]:
+        if outcome != "preempted":
+            return None
+        self.preemptions += 1
+        live_after = len([i for i in self.replica_set.live()
+                          if i != index and i not in self._draining])
+        verdict = self.policy.on_preemption(live_after)
+        self._record("preempt_capacity", replica=index,
+                     attempt=attempt, verdict=verdict,
+                     live_after=live_after)
+        self.flight.dump("preempt_capacity", include_hbm=False)
+        return "requeue_now" if verdict == "replace" else "stop"
+
+    def note_preemption(self, index: int) -> str:
+        """Public flavor of the hook for callers that classify exits
+        themselves; returns the policy verdict."""
+        hint = self._on_outcome(index, None, "preempted", 0, 75)
+        return "replace" if hint == "requeue_now" else "shed"
+
+    # ------------------------------------------------------ background
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - the loop must live
+                self.last_tick_error = repr(e)
+                self.flight.record("tick_error", error=repr(e))
+
+    def start(self) -> "FleetController":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = obs_threads.spawn(
+                self._run, name="fleet-controller", daemon=True)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.flight.record("controller_stop", ticks=self.ticks,
+                           scale_ups=self.scale_ups,
+                           scale_downs=self.scale_downs,
+                           drains=self.drains, requeues=self.requeues,
+                           preemptions=self.preemptions)
+        self.flight.dump("controller_stop", include_hbm=False)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "ticks": self.ticks,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "drains": self.drains,
+            "requeues": self.requeues,
+            "preemptions": self.preemptions,
+            "draining": sorted(self._draining),
+            "live": self.replica_set.live(),
+            "policy": self.policy.snapshot(),
+        }
+
+
+def _replica_index(row: Dict[str, Any]) -> Optional[int]:
+    try:
+        return int(row.get("replica"))
+    except (TypeError, ValueError):
+        return None
+
+
+def _sig(decision) -> Dict[str, Any]:
+    """Decision signals flattened for a flight event (prefixed so they
+    never collide with the event's own keys)."""
+    return {f"sig_{k}": v for k, v in decision.signals.items()}

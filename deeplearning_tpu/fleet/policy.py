@@ -1,0 +1,211 @@
+"""Scaling policy: rollup signals in, one actuation verdict out.
+
+The policy is the pure middle of the controller's sense→decide→act
+loop: :meth:`FleetPolicy.observe` takes one ``obs/fleet.py`` rollup plus
+the live replica count and returns a :class:`Decision` — ``scale_up``,
+``scale_down``, or ``hold`` — with the smoothed signals that justified
+it. No I/O, no threads, no clock reads of its own (callers pass
+``now``), so every hysteresis corner is unit-testable in microseconds.
+
+The "sustained, not instantaneous" judgment reuses the admission
+controller's :class:`~..serve.admission.Ewma` smoothing, then demands a
+*streak*: a signal must breach for ``breach_polls`` consecutive
+observations before a scale-up, and the fleet must sit idle for
+``idle_polls`` before a scale-down — one hiccup batch or one quiet
+second never moves capacity. ``cooldown_s`` spaces consecutive actions
+so a decision gets to land (a replica takes seconds to warm) before the
+next one is considered; min/max bounds are absolute.
+
+Preemption is a capacity event, not a failure: :meth:`on_preemption`
+answers "replace or shed?" from the same smoothed demand signals —
+replace while there is work (or the floor is at risk), shed when the
+fleet was idle anyway.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from ..serve.admission import Ewma
+
+__all__ = ["Decision", "FleetPolicy"]
+
+
+class Decision:
+    """One policy verdict. ``action`` is ``"scale_up"``/``"scale_down"``/
+    ``"hold"``; ``reason`` names the trigger (``"p99_breach"``,
+    ``"sustained_idle"``, ``"cooldown"``, ...); ``signals`` carries the
+    smoothed values the verdict was computed from, ready for a flight
+    event."""
+
+    __slots__ = ("action", "reason", "signals")
+
+    def __init__(self, action: str, reason: str,
+                 signals: Optional[Dict[str, Any]] = None):
+        self.action = action
+        self.reason = reason
+        self.signals = dict(signals or {})
+
+    def __repr__(self) -> str:
+        return f"Decision({self.action!r}, {self.reason!r})"
+
+
+class FleetPolicy:
+    """Hysteresis autoscaler over fleet rollups.
+
+    Scale-up triggers (any, sustained for ``breach_polls`` polls, EWMA-
+    smoothed):
+
+    - e2e p99 (max over replicas) above ``p99_budget_ms``;
+    - queue depth per live replica above ``queue_high``;
+    - error burn (rejected + timed-out per delta window over submitted)
+      above ``error_rate_budget``.
+
+    Scale-down: ``idle_polls`` consecutive polls with (smoothed) empty
+    queues, no breach, and per-replica QPS under ``idle_qps`` — and
+    never below ``min_replicas``.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 8,
+                 p99_budget_ms: float = 500.0,
+                 queue_high: float = 16.0,
+                 error_rate_budget: float = 0.05,
+                 idle_qps: float = 0.05,
+                 breach_polls: int = 3,
+                 idle_polls: int = 6,
+                 cooldown_s: float = 30.0,
+                 alpha: float = 0.2):
+        if min_replicas < 0 or max_replicas < max(min_replicas, 1):
+            raise ValueError(
+                f"bad bounds min={min_replicas} max={max_replicas}")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.p99_budget_ms = float(p99_budget_ms)
+        self.queue_high = float(queue_high)
+        self.error_rate_budget = float(error_rate_budget)
+        self.idle_qps = float(idle_qps)
+        self.breach_polls = int(breach_polls)
+        self.idle_polls = int(idle_polls)
+        self.cooldown_s = float(cooldown_s)
+        # the admission controller's smoothing, one curve per signal
+        self.p99 = Ewma(alpha)
+        self.queue_per_replica = Ewma(alpha)
+        self.error_burn = Ewma(alpha)
+        self.qps_per_replica = Ewma(alpha)
+        self.breach_streak = 0
+        self.idle_streak = 0
+        self.decisions = 0
+        self._last_action_at: Optional[float] = None
+
+    # -------------------------------------------------------- signals
+    def _signals(self, rollup: Dict[str, Any],
+                 live: int) -> Dict[str, Any]:
+        live = max(int(live), 1)
+        delta = rollup.get("delta") or {}
+        # error burn from the delta window when available (a restart
+        # resets totals; the cumulative ratio would mask a fresh burn),
+        # else the cumulative rate
+        submitted = delta.get("requests_total", 0.0) \
+            + delta.get("rejected_total", 0.0)
+        if submitted > 0:
+            burn = (delta.get("rejected_total", 0.0)
+                    + delta.get("timed_out_total", 0.0)) / submitted
+        elif delta.get("dt_s", 0.0) > 0:
+            burn = 0.0                 # a window with no traffic
+        else:
+            burn = rollup.get("error_rate", 0.0)
+        qps = rollup.get("qps_total", 0.0)
+        return {
+            "p99_ms": self.p99.update(
+                rollup.get("e2e_ms_p99_max", 0.0)),
+            "queue_per_replica": self.queue_per_replica.update(
+                rollup.get("queue_depth_total", 0.0) / live),
+            "error_burn": self.error_burn.update(burn),
+            "qps_per_replica": self.qps_per_replica.update(qps / live),
+            "qps_total": qps,
+            "live_replicas": live,
+        }
+
+    def _in_cooldown(self, now: float) -> bool:
+        return (self._last_action_at is not None
+                and now - self._last_action_at < self.cooldown_s)
+
+    # -------------------------------------------------------- observe
+    def observe(self, rollup: Dict[str, Any], live: int,
+                now: Optional[float] = None) -> Decision:
+        """Fold one rollup; return the actuation verdict for a fleet of
+        ``live`` routable replicas."""
+        now = time.monotonic() if now is None else now
+        sig = self._signals(rollup, live)
+        breaches = []
+        if sig["p99_ms"] > self.p99_budget_ms:
+            breaches.append("p99_breach")
+        if sig["queue_per_replica"] > self.queue_high:
+            breaches.append("queue_breach")
+        if sig["error_burn"] > self.error_rate_budget:
+            breaches.append("error_burn")
+        idle = (not breaches
+                and sig["queue_per_replica"] < 1.0
+                and sig["qps_per_replica"] <= self.idle_qps)
+        self.breach_streak = self.breach_streak + 1 if breaches else 0
+        self.idle_streak = self.idle_streak + 1 if idle else 0
+        sig["breach_streak"] = self.breach_streak
+        sig["idle_streak"] = self.idle_streak
+        self.decisions += 1
+
+        if live < self.min_replicas:
+            return self._act("scale_up", "below_min", sig, now)
+        if breaches and self.breach_streak >= self.breach_polls:
+            if live >= self.max_replicas:
+                return Decision("hold", "at_max", sig)
+            if self._in_cooldown(now):
+                return Decision("hold", "cooldown", sig)
+            return self._act("scale_up", breaches[0], sig, now)
+        if idle and self.idle_streak >= self.idle_polls:
+            if live <= self.min_replicas:
+                return Decision("hold", "at_min", sig)
+            if self._in_cooldown(now):
+                return Decision("hold", "cooldown", sig)
+            return self._act("scale_down", "sustained_idle", sig, now)
+        return Decision("hold", "within_band", sig)
+
+    def _act(self, action: str, reason: str, sig: Dict[str, Any],
+             now: float) -> Decision:
+        self._last_action_at = now
+        # an action consumes the streak that earned it: the NEXT action
+        # needs fresh evidence gathered after this one lands
+        self.breach_streak = 0
+        self.idle_streak = 0
+        return Decision(action, reason, sig)
+
+    # ----------------------------------------------------- preemption
+    def on_preemption(self, live_after: int) -> str:
+        """Exit-75 verdict: ``"replace"`` (requeue the replica now) or
+        ``"shed"`` (fold the lost capacity). Replace whenever demand is
+        not provably idle or the floor is at risk — losing a replica
+        during load must not wait out a backoff curve."""
+        if live_after < self.min_replicas:
+            return "replace"
+        if self.idle_streak >= self.idle_polls \
+                and live_after >= self.min_replicas:
+            return "shed"
+        return "replace"
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "p99_budget_ms": self.p99_budget_ms,
+            "queue_high": self.queue_high,
+            "error_rate_budget": self.error_rate_budget,
+            "breach_polls": self.breach_polls,
+            "idle_polls": self.idle_polls,
+            "cooldown_s": self.cooldown_s,
+            "breach_streak": self.breach_streak,
+            "idle_streak": self.idle_streak,
+            "p99_ms": round(self.p99.value, 3),
+            "queue_per_replica": round(self.queue_per_replica.value, 3),
+            "error_burn": round(self.error_burn.value, 5),
+            "qps_per_replica": round(self.qps_per_replica.value, 3),
+        }

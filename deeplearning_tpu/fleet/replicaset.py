@@ -1,0 +1,132 @@
+"""Replica set: N supervised children as one resizable collection.
+
+``tools/supervise.py --replicas N`` (PR 11) ran a FIXED fleet — N
+supervisor loops started together, joined together. The controller
+needs the same loops as a mutable set: ``spawn()`` adds a replica at
+runtime (scale-up, replacement), ``stop(i)``/``restart(i)`` drive one
+member's :class:`~..elastic.supervisor.Supervisor` directives
+(drain-and-requeue, scale-down), and ``live()``/``results()`` answer
+the census questions the policy and the exit classifier ask.
+
+Each member is one ``Supervisor.run()`` on its own non-daemon
+``supervise-<i>`` thread (via the ``obs/threads.py`` spawn registry —
+DLT204). Indices are monotonic: a replacement spawned after replica 2
+died is replica 3 with a fresh workdir, never a reused identity whose
+stale endpoint/heartbeat files could alias the corpse.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.supervisor import Supervisor, SupervisorConfig
+from ..obs import threads as obs_threads
+
+__all__ = ["ReplicaSet"]
+
+
+class ReplicaSet:
+    """``config_factory(index) -> SupervisorConfig`` builds each
+    member's supervisor config (argv, workdir ``replica-<i>/``, env —
+    ``tools/supervise.py`` owns that recipe). ``on_outcome``, when set,
+    is called as ``on_outcome(index, supervisor, outcome, attempt, rc)``
+    for every natural child ending and may return the supervisor hints
+    (``"requeue_now"``/``"stop"``) — the controller's
+    preemption-as-capacity hook."""
+
+    def __init__(self, config_factory: Callable[[int], SupervisorConfig],
+                 *, on_outcome: Optional[Callable[..., Optional[str]]]
+                 = None):
+        self._factory = config_factory
+        self._lock = threading.Lock()
+        self._members: Dict[int, Dict[str, Any]] = {}
+        self._next_index = 0
+        self.on_outcome = on_outcome
+
+    # ----------------------------------------------------------- spawn
+    def spawn(self, index: Optional[int] = None) -> int:
+        """Add (and start) one supervised replica; returns its index."""
+        with self._lock:
+            if index is None:
+                index = self._next_index
+            self._next_index = max(self._next_index, index + 1)
+            existing = self._members.get(index)
+            if existing is not None and existing["thread"].is_alive():
+                raise ValueError(f"replica {index} already running")
+
+        sup = Supervisor(self._factory(index))
+        if self.on_outcome is not None:
+            def _hook(_sup, outcome, attempt, rc, _i=index):
+                return self.on_outcome(_i, _sup, outcome, attempt, rc)
+            sup.on_outcome = _hook
+        member: Dict[str, Any] = {"sup": sup, "rc": None}
+
+        def _run(_m=member, _s=sup):
+            _m["rc"] = _s.run()
+
+        # non-daemon: a supervisor mid-kill-grace must not be reaped by
+        # interpreter exit; join() below is the retirement point
+        member["thread"] = obs_threads.spawn(  # dltpu: allow(DLT203)
+            _run, name=f"supervise-{index}", daemon=False, start=False)
+        with self._lock:
+            self._members[index] = member
+        member["thread"].start()
+        return index
+
+    # ------------------------------------------------------ directives
+    def supervisor(self, index: int) -> Optional[Supervisor]:
+        m = self._members.get(index)
+        return m["sup"] if m else None
+
+    def stop(self, index: int, reason: str = "requested") -> bool:
+        sup = self.supervisor(index)
+        if sup is None:
+            return False
+        sup.request_stop(reason)
+        return True
+
+    def restart(self, index: int, reason: str = "requested") -> bool:
+        sup = self.supervisor(index)
+        if sup is None:
+            return False
+        sup.request_restart(reason)
+        return True
+
+    def stop_all(self, reason: str = "shutdown") -> None:
+        for index in list(self._members):
+            self.stop(index, reason)
+
+    # ---------------------------------------------------------- census
+    def indices(self) -> List[int]:
+        with self._lock:
+            return sorted(self._members)
+
+    def live(self) -> List[int]:
+        """Indices whose supervisor loop is still running (the child
+        itself may be mid-requeue — live means "this slot is managed",
+        which is what capacity math wants)."""
+        with self._lock:
+            return sorted(i for i, m in self._members.items()
+                          if m["thread"].is_alive())
+
+    def results(self) -> Dict[int, Optional[int]]:
+        with self._lock:
+            return {i: m["rc"] for i, m in sorted(self._members.items())}
+
+    def outcomes(self) -> Dict[int, Optional[str]]:
+        with self._lock:
+            return {i: m["sup"].final_outcome
+                    for i, m in sorted(self._members.items())}
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Join every member thread (``timeout`` applies per member);
+        True when all finished."""
+        done = True
+        for i in self.indices():
+            m = self._members.get(i)
+            if m is None:
+                continue
+            m["thread"].join(timeout)
+            done = done and not m["thread"].is_alive()
+        return done

@@ -1,0 +1,149 @@
+"""Fleet router: the client-side front queue over N replica URLs.
+
+The drain half of drain-and-requeue only works if SOMETHING stops
+routing to a draining replica — in production that is a balancer
+honoring 503s; in this repo (and its tier-1 choreography test) it is
+this stdlib router: round-robin over replicas whose last ``/healthz``
+read was routable (``ready``/``warming``/``degraded`` — states that
+still answer), with failover on refusal. A replica reporting
+``draining``/``wedged``/unreachable is skipped at the health refresh,
+and a request that still lands on one (the refresh is periodic, not
+clairvoyant) fails over to the next distinct replica instead of
+surfacing the 503/connection error to the caller.
+
+Host-side only — urllib, no jax — usable from ``tools/loadgen.py``
+(HTTP open-loop mode) and tests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FleetRouter"]
+
+# healthz statuses a request may still be sent to: a warming replica
+# queues (slowly), a degraded one sheds but answers; draining and
+# wedged ones must see no NEW traffic
+_ROUTABLE = ("ready", "warming", "degraded")
+
+
+class FleetRouter:
+    """Round-robin + failover over ``urls`` (or a live ``refresh_fn``
+    returning the current URL set, e.g. a ``discover_endpoints``
+    closure — scale-ups join the rotation at the next refresh)."""
+
+    def __init__(self, urls: Sequence[str] = (), *,
+                 refresh_fn=None,
+                 health_ttl_s: float = 0.5,
+                 timeout_s: float = 10.0):
+        self._urls = [u.rstrip("/") for u in urls]
+        self._refresh_fn = refresh_fn
+        self.health_ttl_s = float(health_ttl_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._status: Dict[str, str] = {}
+        self._checked_at = 0.0
+        self.sent = 0
+        self.failovers = 0
+        self.no_route = 0
+        self.refresh_errors = 0
+        self.last_refresh_error: Optional[str] = None
+
+    # ---------------------------------------------------------- health
+    def _healthz(self, url: str) -> str:
+        try:
+            req = urllib.request.Request(url + "/healthz")
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                doc = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                doc = json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001 - body optional
+                return "unreachable"
+        except (OSError, ValueError, urllib.error.URLError):
+            return "unreachable"
+        return str(doc.get("status", "unreachable"))
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            stale = force or now - self._checked_at >= self.health_ttl_s
+            if not stale:
+                return
+            self._checked_at = now
+        if self._refresh_fn is not None:
+            try:
+                self._urls = [u.rstrip("/")
+                              for u in self._refresh_fn()]
+            except Exception as e:  # noqa: BLE001 - keep the last set
+                self.refresh_errors += 1
+                self.last_refresh_error = repr(e)
+        status = {u: self._healthz(u) for u in list(self._urls)}
+        with self._lock:
+            self._status = status
+
+    def routable(self) -> List[str]:
+        self._refresh()
+        with self._lock:
+            return [u for u in self._urls
+                    if self._status.get(u) in _ROUTABLE]
+
+    def statuses(self) -> Dict[str, str]:
+        self._refresh()
+        with self._lock:
+            return dict(self._status)
+
+    # ----------------------------------------------------------- send
+    def post(self, path: str, body: bytes,
+             headers: Optional[Dict[str, str]] = None
+             ) -> Tuple[int, Any, Optional[str]]:
+        """POST ``body`` to ``path`` on the next routable replica,
+        failing over through every distinct routable replica on
+        connection errors / 503 / 429 before giving up. Returns
+        ``(status_code, payload, url)``; ``(0, None, None)`` when no
+        replica is routable at all."""
+        targets = self.routable()
+        if not targets:
+            self._refresh(force=True)
+            targets = self.routable()
+        if not targets:
+            self.no_route += 1
+            return 0, None, None
+        with self._lock:
+            start = self._rr % len(targets)
+            self._rr += 1
+        last: Tuple[int, Any, Optional[str]] = (0, None, None)
+        for i in range(len(targets)):
+            url = targets[(start + i) % len(targets)]
+            code, payload = self._post_one(url + path, body, headers)
+            if code not in (0, 429, 503):
+                self.sent += 1
+                return code, payload, url
+            last = (code, payload, url)
+            self.failovers += 1
+        return last
+
+    def _post_one(self, url: str, body: bytes,
+                  headers: Optional[Dict[str, str]]
+                  ) -> Tuple[int, Any]:
+        req = urllib.request.Request(url, data=body,
+                                     headers=headers or {},
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001
+                return e.code, None
+        except (OSError, ValueError, urllib.error.URLError):
+            return 0, None

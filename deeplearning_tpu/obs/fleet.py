@@ -36,8 +36,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "SLOPolicy", "FleetScraper", "parse_prometheus_text",
-    "scrape_replica", "compute_rollup", "discover_endpoints",
-    "FLEET_FILE",
+    "scrape_replica", "compute_rollup", "rollup_delta",
+    "discover_endpoints", "record_fleet_event", "FLEET_FILE",
 ]
 
 FLEET_FILE = "fleet.jsonl"
@@ -261,6 +261,36 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
     return rollup
 
 
+_DELTA_COUNTERS = ("requests_total", "completed_total",
+                   "rejected_total", "timed_out_total")
+
+
+def rollup_delta(prev: Optional[Dict[str, Any]],
+                 cur: Dict[str, Any]) -> Dict[str, Any]:
+    """Counter movement between two rollups — the *rate* view a
+    controller scales on (cumulative totals only ever grow, so "is the
+    fleet actually serving right now" needs the difference). Pure.
+    Negative movement (a replica restarted and its counters reset) is
+    clamped to 0 rather than reported as negative throughput."""
+    dt = max(cur.get("time", 0.0) - (prev or {}).get("time", 0.0), 0.0)
+    delta: Dict[str, Any] = {"dt_s": round(dt, 3)}
+    for key in _DELTA_COUNTERS:
+        d = cur.get(key, 0.0) - (prev or {}).get(key, 0.0)
+        d = max(d, 0.0)
+        delta[key] = d
+        delta[key.replace("_total", "_per_s")] = (
+            round(d / dt, 3) if dt > 0 else 0.0)
+    return delta
+
+
+def record_fleet_event(kind: str, **data: Any) -> None:
+    """Controller actuation events (``fleet_scale``/``fleet_drain``/
+    ``fleet_requeue``) into the process flight ring — the same ring the
+    ``slo_breach`` triggers land in, so cause and action interleave in
+    one timeline. Best-effort, like every fleet flight write."""
+    _flight_record(kind, **data)
+
+
 class SLOPolicy:
     """Fleet SLO: an e2e p99 budget and an error-rate budget (rejected +
     timed-out over submitted). ``evaluate`` stamps the verdict into the
@@ -315,10 +345,30 @@ def _thread_registry():
     return mod
 
 
-def discover_endpoints(run_dir: str) -> List[str]:
+def _pid_alive(pid: Any) -> bool:
+    try:
+        pid = int(pid)
+    except (TypeError, ValueError):
+        return False
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)               # signal 0: existence probe only
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True                   # exists but not ours (EPERM)
+    return True
+
+
+def discover_endpoints(run_dir: str, *,
+                       live_only: bool = False) -> List[str]:
     """Replica URLs advertised under a supervisor workdir: reads
     ``endpoint.json`` in the dir itself and in each ``replica-*/``
-    child dir, ordered by replica id then path."""
+    child dir, ordered by replica id then path. With ``live_only`` the
+    advertised pid must still exist — endpoint files are per-workdir
+    leftovers that outlive their process, and a controller that counts
+    a dead replica's stale advert as capacity will never scale up."""
     candidates = [os.path.join(run_dir, "endpoint.json")]
     try:
         entries = sorted(os.listdir(run_dir))
@@ -338,6 +388,8 @@ def discover_endpoints(run_dir: str) -> List[str]:
         url = doc.get("url") if isinstance(doc, dict) else None
         if not url:
             continue
+        if live_only and not _pid_alive(doc.get("pid")):
+            continue
         try:
             order = int(doc.get("replica", len(found)))
         except (TypeError, ValueError):
@@ -356,20 +408,46 @@ class FleetScraper:
                  slo: Optional[SLOPolicy] = None,
                  fleet_path: Optional[str] = None,
                  timeout_s: float = 2.0,
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0,
+                 breach_cooldown_s: float = 60.0):
         self.endpoints = list(endpoints)
         self.slo = slo
         self.fleet_path = fleet_path
         self.timeout_s = float(timeout_s)
         self.interval_s = max(float(interval_s), 0.05)
+        # slo_breach events are EDGE-triggered per signal: one event when
+        # a signal starts breaching, at most one refresher per cooldown
+        # while it stays breached, one slo_clear when it recovers — a
+        # 10-minute sustained breach is 10-ish events, not 120 identical
+        # lines flooding the flight ring
+        self.breach_cooldown_s = float(breach_cooldown_s)
         self.polls = 0
         self.breaches = 0
         self.model_breaches = 0
         self.last_rollup: Optional[Dict[str, Any]] = None
+        self._breach_fired_at: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- poll
+    def _edge(self, key: str, breached: bool, now: float) -> bool:
+        """True when a breach event should FIRE for this signal now:
+        the rising edge, or a cooldown-spaced refresher while sustained.
+        Falling edges emit one ``slo_clear`` and reset the state."""
+        fired_at = self._breach_fired_at.get(key)
+        if breached:
+            if fired_at is None:
+                self._breach_fired_at[key] = now
+                return True
+            if now - fired_at >= self.breach_cooldown_s:
+                self._breach_fired_at[key] = now
+                return True
+            return False
+        if fired_at is not None:
+            del self._breach_fired_at[key]
+            _flight_record("slo_clear", signal=key)
+        return False
+
     def scrape_once(self) -> Dict[str, Any]:
         samples = [scrape_replica(u, self.timeout_s)
                    for u in self.endpoints]
@@ -378,32 +456,38 @@ class FleetScraper:
             {k: s.get(k) for k in ("url", "replica", "run_id", "status")
              if s.get(k) is not None}
             for s in samples]
+        rollup["delta"] = rollup_delta(self.last_rollup, rollup)
         self.polls += 1
         self.last_rollup = rollup
+        now = time.monotonic()
         verdict = rollup.get("slo") or {}
         if verdict.get("breach"):
             self.breaches += 1
-            for signal, flag in (("p99", "p99_breach"),
-                                 ("error_rate", "error_breach")):
-                if verdict.get(flag):
-                    _flight_record(
-                        "slo_breach", signal=signal,
-                        p99_ms=verdict["p99_ms"],
-                        p99_budget_ms=verdict["p99_budget_ms"],
-                        error_rate=verdict["error_rate"],
-                        error_rate_budget=verdict["error_rate_budget"],
-                        qps_total=rollup["qps_total"],
-                        replicas=rollup["replicas"])
+        for signal, flag in (("p99", "p99_breach"),
+                             ("error_rate", "error_breach")):
+            if self._edge(signal, bool(verdict.get(flag)), now):
+                _flight_record(
+                    "slo_breach", signal=signal,
+                    p99_ms=verdict["p99_ms"],
+                    p99_budget_ms=verdict["p99_budget_ms"],
+                    error_rate=verdict["error_rate"],
+                    error_rate_budget=verdict["error_rate_budget"],
+                    qps_total=rollup["qps_total"],
+                    replicas=rollup["replicas"])
         # per-tenant breaches: one event per breaching model so the
-        # controller can act on the hot tenant, not the whole fleet
-        for model, row in sorted((rollup.get("models") or {}).items()):
+        # controller can act on the hot tenant, not the whole fleet —
+        # edge-triggered per (model, signal) like the fleet-wide pair
+        models = rollup.get("models") or {}
+        for model, row in sorted(models.items()):
             mv = row.get("slo") or {}
             if mv.get("breach"):
                 self.model_breaches += 1
+            breach_signal = ("p99" if mv.get("p99_breach")
+                             else "error_rate")
+            if self._edge(f"model:{model}", bool(mv.get("breach")), now):
                 _flight_record(
                     "slo_breach", model=model,
-                    signal=("p99" if mv.get("p99_breach")
-                            else "error_rate"),
+                    signal=breach_signal,
                     p99_ms=mv["p99_ms"],
                     p99_budget_ms=mv["p99_budget_ms"],
                     error_rate=mv["error_rate"],

@@ -25,8 +25,37 @@ import threading
 import time
 from typing import Dict, Optional, Sequence
 
-__all__ = ["AdmissionController", "TenantAdmission", "Rejected",
+__all__ = ["Ewma", "AdmissionController", "TenantAdmission", "Rejected",
            "DeadlineExceeded"]
+
+
+class Ewma:
+    """Exponentially-weighted moving average with first-sample seeding:
+    the first ``update`` sets the value outright, later ones fold in at
+    ``alpha`` — the "sustained, not instantaneous" smoothing used for
+    the admission drain rate and the fleet controller's scaling signals
+    (one smoothing rule, one set of tests)."""
+
+    __slots__ = ("alpha", "value", "samples")
+
+    def __init__(self, alpha: float = 0.2):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.value = 0.0
+        self.samples = 0
+
+    def update(self, sample: float) -> float:
+        sample = float(sample)
+        self.value = (sample if self.samples == 0
+                      else (1.0 - self.alpha) * self.value
+                      + self.alpha * sample)
+        self.samples += 1
+        return self.value
+
+    def reset(self) -> None:
+        self.value = 0.0
+        self.samples = 0
 
 
 class Rejected(Exception):
@@ -85,7 +114,7 @@ class AdmissionController:
         # state: in multi-tenant serving every model owns one controller
         # (see TenantAdmission), so a 429's retry_after always quotes
         # the TARGET model's drain — never a hotter neighbor's.
-        self._drain_rate = 0.0
+        self._drain = Ewma(alpha=0.2)
 
     # ----------------------------------------------------- backpressure
     def admit(self, queue_depth: int) -> None:
@@ -93,6 +122,10 @@ class AdmissionController:
         if queue_depth >= self.max_queue:
             raise Rejected(queue_depth, self.retry_after_s(queue_depth),
                            model=self.model)
+
+    @property
+    def _drain_rate(self) -> float:
+        return self._drain.value
 
     def retry_after_s(self, queue_depth: int) -> float:
         """Time until the backlog plausibly has room: depth over the
@@ -106,9 +139,7 @@ class AdmissionController:
         the queue over ``seconds`` of dispatch."""
         if seconds <= 0:
             return
-        rate = n / seconds
-        self._drain_rate = (rate if self._drain_rate == 0.0
-                            else 0.8 * self._drain_rate + 0.2 * rate)
+        self._drain.update(n / seconds)
 
     # -------------------------------------------------------- deadlines
     def deadline_for(self, timeout_s: Optional[float],

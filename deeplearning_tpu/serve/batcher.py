@@ -46,10 +46,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..elastic import faults
 from ..obs import flight
 from ..obs import threads as obs_threads
 from ..obs.spans import span
-from .admission import AdmissionController, DeadlineExceeded
+from .admission import AdmissionController, DeadlineExceeded, Rejected
 from .telemetry import ServeTelemetry
 
 __all__ = ["MicroBatcher", "SubmitHandle"]
@@ -192,6 +193,12 @@ class MicroBatcher:
         self._busy = False             # dispatch thread is inside a batch
         self._ids = itertools.count()
         self._stop = threading.Event()
+        # fleet surface: drain() flips _draining (new submits 429 with
+        # reason="draining", queued work still dispatches); on_preempt,
+        # when set by the owning CLI, is invoked once if a
+        # preempt_replica fault targets this replica
+        self._draining = threading.Event()
+        self.on_preempt = None
         self._thread: Optional[threading.Thread] = None
         if start:
             self.start()
@@ -236,6 +243,27 @@ class MicroBatcher:
         but not yet demuxed) — a wedge detector must not call an
         in-flight batch idle."""
         return self._busy
+
+    # ------------------------------------------------------------ drain
+    def drain(self) -> None:
+        """Stop ACCEPTING without stopping WORKING: new submits are
+        rejected (429 reason="draining", retry elsewhere) while every
+        already-queued request still dispatches — the graceful half of
+        the controller's drain-and-requeue. Idempotent."""
+        if not self._draining.is_set():
+            self._draining.set()
+            flight.record("serve_drain", depth=self.queue_depth)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain has fully flushed: draining was requested,
+        the lanes are empty, and no batch is in flight."""
+        return (self._draining.is_set() and not self._busy
+                and self.queue_depth == 0)
 
     # -------------------------------------------------------- lanes
     def _lane(self, model: Optional[str]) -> _Lane:
@@ -290,6 +318,11 @@ class MicroBatcher:
             raise ValueError(f"request image shape {image.shape} != "
                              f"({size}, {size}, 3); resize client-side")
         try:
+            if self._draining.is_set():
+                # a draining replica refuses new work outright — no
+                # retry_after hint would help; the caller must reroute
+                raise Rejected(len(lane.q), 0.0, model=lane.model,
+                               reason="draining")
             if self.zoo is not None:
                 # warm fast-path: dict lookup. Cold: kicks a background
                 # hot-load (may LRU-evict; raises Rejected on pressure)
@@ -401,8 +434,28 @@ class MicroBatcher:
                 batch.append(req)
         return batch
 
+    def _poll_faults(self) -> None:
+        """Fleet-choreography fault hooks, polled once per dispatch-loop
+        iteration (~20 Hz when idle). ``wedge_replica`` freezes THIS
+        thread while the heartbeat writer stays alive — ``dispatched``
+        stops with work queued, exactly the frozen-stream signature
+        ``DispatchWatch`` classifies. ``preempt_replica`` hands control
+        to the CLI's callback (drain → exit 75); it is only consumed
+        once a callback exists, so the spec can't burn before the
+        owner wires it."""
+        if faults.consume("wedge_replica", "step", self.dispatched):
+            deadline = time.monotonic() + faults.WEDGE_SLEEP_S
+            while (not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                self._stop.wait(0.25)
+        cb = self.on_preempt
+        if cb is not None and faults.consume(
+                "preempt_replica", "step", self.dispatched):
+            cb()
+
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
+            self._poll_faults()
             picked = self._pick_lane()
             if picked is None:
                 continue

@@ -68,17 +68,21 @@ def health(engine, batcher=None,
 
     200 "ready": warm engine, not shedding. 503 "warming" until every
     bucket is compiled; 503 "degraded" while admission sheds; 503
-    "wedged" (takes precedence) when ``wedge`` reports a frozen
-    dispatch stream. Pure host reads — never compiles, never syncs the
-    device."""
+    "draining" while the batcher refuses new work but still flushes its
+    lanes (the controller's drain-and-requeue window — routers must
+    stop sending, in-flight clients still get answers); 503 "wedged"
+    (highest precedence) when ``wedge`` reports a frozen dispatch
+    stream. Pure host reads — never compiles, never syncs the device."""
     warm = engine.compile_count >= len(engine.buckets)
     depth = batcher.queue_depth if batcher is not None else 0
     shed = (batcher.admission.overloaded(depth)
             if batcher is not None else False)
     wedged = wedge is not None and wedge.verdict() == "wedged"
+    draining = bool(getattr(batcher, "draining", False))
     status = "wedged" if wedged else (
-        "ready" if warm and not shed else (
-            "warming" if not warm else "degraded"))
+        "draining" if draining else (
+            "ready" if warm and not shed else (
+                "warming" if not warm else "degraded")))
     payload: Dict[str, Any] = {
         "status": status,
         "engine_warm": warm,
@@ -88,6 +92,8 @@ def health(engine, batcher=None,
         "task": engine.task,
         "buckets": list(engine.buckets),
         "wedged": wedged,
+        "draining": draining,
+        "drained": bool(getattr(batcher, "drained", False)),
     }
     if batcher is not None:
         payload["e2e_ms_p99"] = batcher.telemetry.latency_ms("e2e")["p99"]
@@ -107,7 +113,8 @@ def zoo_health(zoo, batcher=None,
     (registered/evicted) tenants do NOT block readiness, because a
     request for one triggers a hot-load rather than an error. 503
     "warming" while any load is in flight, "degraded" while any lane
-    sheds, "wedged" (precedence) on a frozen dispatch stream. The
+    sheds, "draining" while the batcher flushes toward a requeue,
+    "wedged" (precedence) on a frozen dispatch stream. The
     payload carries the full per-model state table (warm/evicted/
     loading, bytes, quotas, queue depths) so per-tenant posture is
     diagnosable from the probe alone. Pure host reads."""
@@ -129,9 +136,11 @@ def zoo_health(zoo, batcher=None,
         any_loading = any_loading or row["state"] == "loading"
         models[alias] = entry
     wedged = wedge is not None and wedge.verdict() == "wedged"
+    draining = bool(getattr(batcher, "draining", False))
     status = "wedged" if wedged else (
-        "warming" if any_loading else (
-            "degraded" if any_shed else "ready"))
+        "draining" if draining else (
+            "warming" if any_loading else (
+                "degraded" if any_shed else "ready")))
     payload: Dict[str, Any] = {
         "status": status,
         "zoo": {k: zs[k] for k in ("registered", "resident", "loads",
@@ -139,6 +148,8 @@ def zoo_health(zoo, batcher=None,
                                    "alert_frac")},
         "models": models,
         "wedged": wedged,
+        "draining": draining,
+        "drained": bool(getattr(batcher, "drained", False)),
     }
     if batcher is not None:
         payload["queue_depth"] = batcher.queue_depth

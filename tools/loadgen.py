@@ -25,6 +25,14 @@ Every run can append a ``--set serve`` row (op schema:
 ``bench_util.append_op_result``) to tools/mfu_results.jsonl so the
 request-path latency trajectory is recorded next to the train-step MFU
 rows; ``--mix`` runs append one row per tenant.
+
+Fleet HTTP mode (``--mode open --fleet-urls`` / ``--fleet-dir``):
+arrivals POST ``/predict`` to a replica fleet through a
+``FleetRouter`` (round-robin, drains skipped, failover on 503) —
+the drive side of the controller choreography test. Open-loop
+records carry a per-second ``timeline`` (QPS split + p99) so
+recovery-after-fault can be asserted against the trajectory, not
+the run-wide aggregate.
 """
 
 from __future__ import annotations
@@ -60,6 +68,44 @@ def _percentiles_ms(lats):
 def make_images(n: int, size: int, seed: int = 0) -> np.ndarray:
     return np.random.default_rng(seed).normal(
         size=(n, size, size, 3)).astype(np.float32)
+
+
+class Timeline:
+    """Per-second QPS/latency buckets for the open-loop modes.
+
+    The aggregate p99 of a 30 s run can look fine while 5 s of it were
+    an outage; the recovery assertions ("p99 back in band within N
+    seconds of the replacement warming") need the trajectory, not the
+    summary. Submissions/rejections bucket at arrival time, completions
+    and their latencies at completion time."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._buckets: dict = {}
+
+    def note(self, key: str, lat=None) -> None:
+        sec = int(time.perf_counter() - self.t0)
+        with self._lock:
+            row = self._buckets.setdefault(
+                sec, {"submitted": 0, "completed": 0, "rejected": 0,
+                      "timed_out": 0, "lats": []})
+            row[key] += 1
+            if lat is not None:
+                row["lats"].append(lat)
+
+    def rows(self) -> list:
+        with self._lock:
+            out = []
+            for sec in sorted(self._buckets):
+                row = self._buckets[sec]
+                out.append({
+                    "t": sec, "submitted": row["submitted"],
+                    "completed": row["completed"],
+                    "rejected": row["rejected"],
+                    "timed_out": row["timed_out"],
+                    "p99_ms": _percentiles_ms(row["lats"])["p99_ms"]})
+            return out
 
 
 def run_sequential(engine, images, n_requests: int) -> dict:
@@ -229,6 +275,7 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
              "timed_out": 0}
     lats = []
     sampler = _MixSampler(mix, images_by_model, images)
+    timeline = Timeline()
     done = threading.Event()
 
     def resolver():
@@ -244,6 +291,7 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
                     state["timed_out"] += 1
                     if alias is not None:
                         sampler.tally(alias, "timed_out")
+                timeline.note("timed_out")
                 continue
             lat = time.perf_counter() - t0
             with lock:
@@ -251,6 +299,7 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
                 lats.append(lat)
                 if alias is not None:
                     sampler.tally(alias, "completed", lat)
+            timeline.note("completed", lat)
 
     from deeplearning_tpu.obs import threads as obs_threads
     pool = [obs_threads.spawn(resolver, daemon=True,
@@ -275,9 +324,11 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
                 state["rejected"] += 1
                 if alias is not None:
                     sampler.tally(alias, "rejected")
+            timeline.note("rejected")
             continue
         with lock:
             state["submitted"] += 1
+        timeline.note("submitted")
         handles.put((t0, alias, handle))
     for _ in pool:
         handles.put(None)
@@ -290,11 +341,90 @@ def run_open_loop(batcher, images, rate_hz: float, duration_s: float,
            **_percentiles_ms(lats),
            "batch_occupancy": snap["batch_occupancy"],
            "queue_depth_mean": snap["queue_depth_mean"],
-           "shed_batches": snap["shed_batches"]}
+           "shed_batches": snap["shed_batches"],
+           "timeline": timeline.rows()}
     models = sampler.model_recs("open", duration_s)
     if models:
         rec["models"] = models
     return rec
+
+
+def run_open_loop_http(router, images, rate_hz: float,
+                       duration_s: float, timeout_s: float = 10.0,
+                       senders: int = 16) -> dict:
+    """Open-loop arrivals POSTed to a replica fleet through a
+    :class:`~deeplearning_tpu.fleet.FleetRouter` — the drive side of
+    the drain-and-requeue choreography. Latency is arrival→response
+    (loadgen queueing included: a stalled fleet shows up as p99, not as
+    a quietly slower arrival process). 2xx counts as completed, a
+    429/503 that survived failover as rejected, connection errors and
+    no-route as timed out."""
+    import io
+    import queue as _queue
+
+    timeline = Timeline()
+    jobs: "_queue.Queue" = _queue.Queue()
+    lock = threading.Lock()
+    state = {"submitted": 0, "completed": 0, "rejected": 0,
+             "timed_out": 0}
+    lats = []
+
+    def sender():
+        while True:
+            item = jobs.get()
+            if item is None:
+                return
+            t0, body = item
+            code, _payload, _url = router.post(
+                "/predict", body,
+                headers={"Content-Type": "application/octet-stream"})
+            lat = time.perf_counter() - t0
+            if 200 <= code < 300:
+                with lock:
+                    state["completed"] += 1
+                    lats.append(lat)
+                timeline.note("completed", lat)
+            elif code in (429, 503):
+                with lock:
+                    state["rejected"] += 1
+                timeline.note("rejected")
+            else:
+                with lock:
+                    state["timed_out"] += 1
+                timeline.note("timed_out")
+
+    from deeplearning_tpu.obs import threads as obs_threads
+    pool = [obs_threads.spawn(sender, daemon=True,
+                              name=f"loadgen-http-{i}")
+            for i in range(senders)]
+    bodies = []
+    for img in images[:16]:
+        buf = io.BytesIO()
+        np.save(buf, img)
+        bodies.append(buf.getvalue())
+    period = 1.0 / rate_hz
+    t_end = time.perf_counter() + duration_s
+    next_t = time.perf_counter()
+    i = 0
+    while time.perf_counter() < t_end:
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(next_t - now)
+        next_t += period
+        with lock:
+            state["submitted"] += 1
+        timeline.note("submitted")
+        jobs.put((time.perf_counter(), bodies[i % len(bodies)]))
+        i += 1
+    for _ in pool:
+        jobs.put(None)
+    for t in pool:
+        t.join(timeout=timeout_s)
+    return {"mode": "open_http", "rate_hz": rate_hz, **state,
+            "req_per_s": round(state["completed"] / duration_s, 1),
+            **_percentiles_ms(lats),
+            "failovers": router.failovers, "no_route": router.no_route,
+            "timeline": timeline.rows()}
 
 
 def append_serve_row(results_path: str, rec: dict, **extra) -> None:
@@ -347,9 +477,46 @@ def main(argv=None) -> int:
                          "image_size, buckets, weight_quant, ...}; "
                          "default: each alias IS its architecture name "
                          "with the CLI's --num-classes/--size")
+    ap.add_argument("--fleet-urls", default=None,
+                    help="open-loop over HTTP instead of in-process: "
+                         "comma-separated replica base URLs routed via "
+                         "FleetRouter (round-robin + failover)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="like --fleet-urls but discover live replica "
+                         "endpoints from this controller run dir on "
+                         "every health refresh (scale-ups join, "
+                         "drained replicas leave)")
     args = ap.parse_args(argv)
     if args.mix and args.mode not in ("closed", "open"):
         ap.error("--mix needs --mode closed or open")
+    if (args.fleet_urls or args.fleet_dir) and args.mode != "open":
+        ap.error("--fleet-urls/--fleet-dir need --mode open")
+
+    if args.fleet_urls or args.fleet_dir:
+        from deeplearning_tpu.fleet import FleetRouter
+        refresh = None
+        urls = []
+        if args.fleet_dir:
+            from deeplearning_tpu.obs.fleet import discover_endpoints
+
+            def refresh(_dir=args.fleet_dir):
+                return discover_endpoints(_dir, live_only=True)
+            urls = refresh()
+        if args.fleet_urls:
+            urls = [u.strip() for u in args.fleet_urls.split(",")
+                    if u.strip()]
+            refresh = None
+        router = FleetRouter(urls, refresh_fn=refresh,
+                             timeout_s=args.timeout_s or 10.0)
+        rec = run_open_loop_http(
+            router, make_images(64, args.size), args.rate,
+            args.duration, timeout_s=args.timeout_s or 10.0)
+        print(json.dumps(rec), flush=True)
+        if (args.results or "").lower() != "none":
+            append_serve_row(args.results or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "mfu_results.jsonl"), rec, model=args.model)
+        return 0
 
     from deeplearning_tpu.serve import (InferenceEngine, MicroBatcher,
                                         ModelZoo)

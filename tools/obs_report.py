@@ -62,6 +62,58 @@ def load_supervisor(run_dir: str) -> Optional[Dict[str, Any]]:
         return json.load(f)
 
 
+def load_controller(run_dir: str) -> Optional[Dict[str, Any]]:
+    """The fleet controller's decision log (scale / drain / requeue /
+    preemption verdicts) — written by ``deeplearning_tpu/fleet`` after
+    every actuation."""
+    path = os.path.join(run_dir, "flightrec_controller.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def controller_summary(doc: Optional[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Fleet-controller posture: every actuation class counted, with
+    the WHY kept (scale reasons, preemption verdicts) — the section the
+    choreography test asserts its decisions showed up in. Pure."""
+    if doc is None:
+        return None
+    ev = doc.get("events", [])
+
+    def of(kind: str) -> List[Dict[str, Any]]:
+        return [e for e in ev if e.get("kind") == kind]
+
+    scales = of("fleet_scale")
+    out: Dict[str, Any] = {
+        "scale_ups": sum(1 for e in scales
+                         if e.get("direction") == "up"),
+        "scale_downs": sum(1 for e in scales
+                           if e.get("direction") == "down"),
+        "scale_reasons": [str(e.get("reason")) for e in scales],
+        "drains": len(of("fleet_drain")),
+        "requeues": len(of("fleet_requeue")),
+        "stops": len(of("fleet_stop")),
+        "preemptions": len(of("preempt_capacity")),
+        "preempt_verdicts": [str(e.get("verdict"))
+                             for e in of("preempt_capacity")],
+        "tick_errors": len(of("tick_error")),
+    }
+    stop = of("controller_stop")
+    if stop:
+        out["ticks"] = stop[-1].get("ticks")
+    policy = (doc.get("config") or {}).get("policy") or {}
+    if policy:
+        out["bounds"] = [policy.get("min_replicas"),
+                         policy.get("max_replicas")]
+    return out
+
+
 def load_registry(run_dir: str) -> Optional[Dict[str, Any]]:
     """The metrics-registry snapshot a Trainer dumps at obs shutdown
     (``metrics_registry.json``) — the same state /metrics exposed live."""
@@ -321,6 +373,10 @@ def summarize(run_dir: str) -> Dict[str, Any]:
     fleet = fleet_summary(fleet_rows)
     if fleet:
         out["fleet"] = fleet
+
+    controller = controller_summary(load_controller(run_dir))
+    if controller:
+        out["controller"] = controller
 
     zoo = zoo_summary(registry_raw, fleet_rows, flight)
     if zoo:
@@ -606,6 +662,27 @@ def render(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  SLO: {ft['slo_breach_polls']}/{ft['polls']} poll(s) "
                 f"in breach (budget {budgets})")
+    ct = summary.get("controller")
+    if ct:
+        lines.append("")
+        line = (f"controller: scale_ups={ct['scale_ups']} "
+                f"scale_downs={ct['scale_downs']} "
+                f"drains={ct['drains']} requeues={ct['requeues']} "
+                f"preemptions={ct['preemptions']}")
+        if ct.get("ticks") is not None:
+            line += f" ticks={ct['ticks']}"
+        if ct.get("bounds"):
+            line += (f" bounds=[{ct['bounds'][0]},"
+                     f"{ct['bounds'][1]}]")
+        if ct.get("tick_errors"):
+            line += f" TICK-ERRORS={ct['tick_errors']}"
+        lines.append(line)
+        if ct.get("scale_reasons"):
+            lines.append("  scale reasons: "
+                         + ", ".join(ct["scale_reasons"]))
+        if ct.get("preempt_verdicts"):
+            lines.append("  preempt verdicts: "
+                         + ", ".join(ct["preempt_verdicts"]))
     z = summary.get("zoo")
     if z:
         lines.append("")
@@ -767,6 +844,26 @@ def _check() -> int:
             f.write(json.dumps({"step": 2, "time": 0.1,
                                 "train/loss": 1e9}) + "\n")
 
+        # fleet-controller decision log, through the same recorder API
+        # (the file deeplearning_tpu/fleet dumps after every actuation)
+        ctl = FlightRecorder(capacity=16)
+        ctl.record("fleet_drain", replica=1, reason="wedged",
+                   then="restart", deadline_s=2.0)
+        ctl.record("fleet_requeue", replica=1, reason="wedged",
+                   drained=False, waited_s=2.0)
+        ctl.record("preempt_capacity", replica=2, attempt=0,
+                   verdict="replace", live_after=2)
+        ctl.record("fleet_scale", direction="up", replica=3,
+                   reason="p99_breach", live=2)
+        ctl.record("fleet_scale", direction="down", replica=3,
+                   reason="sustained_idle", live=3)
+        ctl.record("controller_stop", ticks=9, scale_ups=1,
+                   scale_downs=1, drains=1, requeues=1, preemptions=1)
+        assert ctl.configure(
+            os.path.join(run_dir, "flightrec_controller.json"),
+            {"policy": {"min_replicas": 2, "max_replicas": 4}}
+        ).dump("controller_stop", include_hbm=False)
+
         # metrics-registry snapshot through the real registry API (the
         # file a Trainer dumps at obs shutdown)
         from deeplearning_tpu.obs import fleet as fleet_mod
@@ -875,6 +972,21 @@ def _check() -> int:
         fleet_view = render_fleet(run_dir)
         assert "BREACH (p99)" in fleet_view, fleet_view
         assert fleet_view.count("\n") >= 5, fleet_view
+        # fleet-controller posture: every actuation class counted, the
+        # whys preserved, policy bounds read from the flight config
+        ct = summary["controller"]
+        assert ct["scale_ups"] == 1 and ct["scale_downs"] == 1, ct
+        assert ct["drains"] == 1 and ct["requeues"] == 1, ct
+        assert ct["preemptions"] == 1, ct
+        assert ct["preempt_verdicts"] == ["replace"], ct
+        assert ct["scale_reasons"] == ["p99_breach",
+                                       "sustained_idle"], ct
+        assert ct["ticks"] == 9 and ct["bounds"] == [2, 4], ct
+        assert ct["tick_errors"] == 0, ct
+        for token in ("controller: scale_ups=1", "requeues=1",
+                      "scale reasons: p99_breach, sustained_idle",
+                      "preempt verdicts: replace"):
+            assert token in report, report
         # zoo posture section: registry labels + fleet per-model fold
         zz = summary["zoo"]
         assert zz["resident"] == 2.0 and zz["loads"] == 3.0, zz

@@ -339,6 +339,15 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                     return self._predict(None)
                 if len(parts) == 2 and zoo is not None:
                     return self._predict(parts[1])
+            elif parts == ["admin", "drain"]:
+                # fleet controller verb: stop accepting, finish lanes.
+                # healthz flips to 503 "draining" so routers reroute;
+                # the controller polls "drained" before the requeue
+                batcher.drain()
+                return self._json(200, {"draining": True,
+                                        "drained": bool(batcher.drained),
+                                        "queue_depth":
+                                            batcher.queue_depth})
             elif (zoo is not None and len(parts) == 3
                     and parts[0] == "admin"
                     and parts[1] in ("load", "evict")):
@@ -358,7 +367,7 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                     out["evicted"] = evicted
                 return self._json(200, out)
             return self._json(404, {
-                "error": "POST /predict[/<model>] or "
+                "error": "POST /predict[/<model>], /admin/drain or "
                          "/admin/{load,evict}/<model>"})
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -366,7 +375,7 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     # advertise the scrape endpoint when a supervisor asked for it
     obs_metrics.write_endpoint(url, role="serve")
     endpoints = ["/predict", "/stats", "/healthz", "/metrics",
-                 "/metrics.json"]
+                 "/metrics.json", "/admin/drain"]
     if zoo is not None:
         endpoints[:1] = ["/predict/<model>", "/models",
                          "/admin/load/<model>", "/admin/evict/<model>"]
@@ -532,7 +541,12 @@ def main(argv=None) -> int:
                 # instead of the default die-mid-request
                 import signal
 
+                from deeplearning_tpu.elastic.preempt import \
+                    EXIT_PREEMPTED
+                from deeplearning_tpu.obs import flight as obs_flight
                 from deeplearning_tpu.obs import threads as obs_threads
+
+                rc_holder = {"rc": 0}
 
                 def _drain(signum, frame):
                     obs_threads.spawn(server.shutdown,
@@ -542,13 +556,28 @@ def main(argv=None) -> int:
                     signal.signal(signal.SIGTERM, _drain)
                 except ValueError:
                     pass           # non-main thread (embedded use)
+
+                # preemption (injected via preempt_replica:<i>, or a
+                # platform eviction the batcher surfaces): drain, shut
+                # down gracefully, and exit 75 so the supervisor
+                # classifies capacity-loss — not a crash, not a clean
+                # completion
+                def _preempted():
+                    rc_holder["rc"] = EXIT_PREEMPTED
+                    obs_flight.record("serve_preempted",
+                                      dispatched=batcher.dispatched)
+                    batcher.drain()
+                    obs_threads.spawn(server.shutdown,
+                                      name="serve-preempt-drain",
+                                      daemon=True)
+                batcher.on_preempt = _preempted
                 try:
                     server.serve_forever()
                 except KeyboardInterrupt:
                     pass
                 finally:
                     server.server_close()
-                return 0
+                return rc_holder["rc"]
             return serve_stdin(batcher, task, size, names,
                                args.topk, args.timeout_s)
     finally:

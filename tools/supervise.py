@@ -20,19 +20,135 @@ under its own Supervisor thread in ``<workdir>/replica-<i>/``, all
 sharing one ``DLTPU_RUN_ID`` and each handed its ``DLTPU_REPLICA``
 index + ``DLTPU_ENDPOINT_FILE`` — the identity contract the heartbeat
 files, ``/metrics`` exposition, and trace dumps all stamp, and the one
-``obs/fleet.py`` discovery + ``tools/trace_merge.py`` join on. Exit
-code is the worst replica's.
+``obs/fleet.py`` discovery + ``tools/trace_merge.py`` join on. The
+exit code is CLASSIFIED, not ``max(rcs)``: crash > wedge > preempted >
+clean (raw 75 would outrank a crash's 1), with the per-replica
+breakdown printed.
+
+Controller mode (``--controller``, README "Fleet controller policy"):
+the fleet becomes elastic — a ``FleetController`` scrapes every
+replica's ``/metrics``+``/healthz`` on a cadence, scales between
+``--min-replicas`` and ``--max-replicas`` on sustained p99 / queue /
+error-burn breach vs sustained idle, drains-and-requeues wedged
+serving replicas (``POST /admin/drain`` → deadline → supervisor
+restart directive), and treats a replica's exit 75 as a capacity
+event (immediate replace-or-shed, no backoff). Decisions are recorded
+to ``<workdir>/flightrec_controller.json``.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
+import threading
 import uuid
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _print_breakdown(rows, file=sys.stderr) -> None:
+    for line in rows:
+        print(f"[supervise] {line}", file=file)
+
+
+def _classified_exit(outcomes, rcs, run_id) -> int:
+    """Fleet verdict: per-replica breakdown + one classified exit."""
+    from deeplearning_tpu.elastic.supervisor import (exit_for_outcome,
+                                                     worst_outcome)
+    labels = {}
+    for i in sorted(outcomes):
+        out = outcomes[i] or ("completed" if not rcs.get(i)
+                              else "crashed")
+        labels[i] = out
+    worst = worst_outcome(list(labels.values()) or ["crashed"])
+    rc = exit_for_outcome(worst)
+    _print_breakdown(
+        [f"replica {i}: {labels[i]} (rc={rcs.get(i)})"
+         for i in sorted(labels)]
+        + [f"fleet done run_id={run_id} worst={worst} exit={rc}"])
+    return rc
+
+
+def run_controller(args, command) -> int:
+    """--controller: replica set + policy + controller, until signaled
+    (or every replica ends on its own)."""
+    from deeplearning_tpu.fleet import (FleetController, FleetPolicy,
+                                        ReplicaSet)
+    from deeplearning_tpu.obs.fleet import SLOPolicy
+
+    run_id = args.run_id or f"run-{uuid.uuid4().hex[:8]}"
+    workdir = os.path.abspath(args.workdir)
+    min_replicas = (args.min_replicas if args.min_replicas is not None
+                    else args.replicas)
+    max_replicas = (args.max_replicas if args.max_replicas is not None
+                    else max(min_replicas * 2, args.replicas, 2))
+
+    def factory(i: int):
+        from deeplearning_tpu.elastic.supervisor import SupervisorConfig
+        return SupervisorConfig(
+            command,
+            workdir=os.path.join(workdir, f"replica-{i}"),
+            max_restarts=args.max_restarts,
+            wedge_deadline_s=args.wedge_deadline,
+            startup_deadline_s=args.startup_deadline,
+            backoff_base_s=args.backoff_base,
+            backoff_factor=args.backoff_factor,
+            backoff_max_s=args.backoff_max,
+            kill_grace_s=args.kill_grace,
+            run_id=run_id,
+            replica=i,
+        )
+
+    replica_set = ReplicaSet(factory)
+    policy = FleetPolicy(
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        p99_budget_ms=args.p99_budget, queue_high=args.queue_high,
+        error_rate_budget=args.error_budget,
+        breach_polls=args.breach_polls, idle_polls=args.idle_polls,
+        cooldown_s=args.cooldown)
+    controller = FleetController(
+        replica_set, policy, run_dir=workdir,
+        slo=SLOPolicy(p99_budget_ms=args.p99_budget,
+                      error_rate_budget=args.error_budget),
+        interval_s=args.scale_interval,
+        drain_deadline_s=args.drain_deadline)
+
+    print(f"[supervise] controller run_id={run_id} "
+          f"replicas={args.replicas} bounds=[{min_replicas},"
+          f"{max_replicas}] workdir={workdir}", file=sys.stderr)
+    for _ in range(args.replicas):
+        replica_set.spawn()
+    controller.start()
+
+    stop_evt = threading.Event()
+
+    def _sig(signum, frame):
+        stop_evt.set()
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(signum, _sig)
+        except ValueError:
+            pass           # non-main thread (embedded use)
+
+    try:
+        while not stop_evt.wait(0.5):
+            if not replica_set.live():
+                break      # every replica ended on its own
+    except KeyboardInterrupt:
+        pass
+    controller.stop()
+    replica_set.stop_all("controller_shutdown")
+    replica_set.join()
+    s = controller.summary()
+    print(f"[supervise] controller done ticks={s['ticks']} "
+          f"scale_ups={s['scale_ups']} scale_downs={s['scale_downs']} "
+          f"drains={s['drains']} requeues={s['requeues']} "
+          f"preemptions={s['preemptions']}", file=sys.stderr)
+    return _classified_exit(replica_set.outcomes(),
+                            replica_set.results(), run_id)
 
 
 def main(argv=None) -> int:
@@ -63,6 +179,38 @@ def main(argv=None) -> int:
     parser.add_argument("--run-id", default=None,
                         help="fleet run id (default: random); exported "
                              "to children as DLTPU_RUN_ID")
+    parser.add_argument("--controller", action="store_true",
+                        help="closed-loop fleet controller: autoscale "
+                             "between --min/--max-replicas, drain-and-"
+                             "requeue wedged replicas, treat exit 75 "
+                             "as capacity")
+    parser.add_argument("--min-replicas", type=int, default=None,
+                        help="controller scale floor (default: "
+                             "--replicas)")
+    parser.add_argument("--max-replicas", type=int, default=None,
+                        help="controller scale ceiling (default: "
+                             "max(2*floor, --replicas, 2))")
+    parser.add_argument("--scale-interval", type=float, default=2.0,
+                        help="controller tick cadence, seconds")
+    parser.add_argument("--drain-deadline", type=float, default=10.0,
+                        help="seconds a draining replica gets to flush "
+                             "before the kill/requeue")
+    parser.add_argument("--p99-budget", type=float, default=500.0,
+                        help="fleet e2e p99 SLO budget, ms")
+    parser.add_argument("--error-budget", type=float, default=0.05,
+                        help="fleet error-burn budget (rejected + "
+                             "timed-out over submitted, per window)")
+    parser.add_argument("--queue-high", type=float, default=16.0,
+                        help="queue depth per live replica that counts "
+                             "as a scaling breach")
+    parser.add_argument("--breach-polls", type=int, default=3,
+                        help="consecutive breached ticks before a "
+                             "scale-up")
+    parser.add_argument("--idle-polls", type=int, default=6,
+                        help="consecutive idle ticks before a "
+                             "scale-down")
+    parser.add_argument("--cooldown", type=float, default=30.0,
+                        help="seconds between scale actions")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command (prefix with --)")
     args = parser.parse_args(argv)
@@ -74,6 +222,9 @@ def main(argv=None) -> int:
         parser.error("no training command given (put it after --)")
     if args.replicas < 1:
         parser.error("--replicas must be >= 1")
+
+    if args.controller:
+        return run_controller(args, command)
 
     from deeplearning_tpu.elastic.supervisor import (Supervisor,
                                                      SupervisorConfig)
@@ -102,13 +253,15 @@ def main(argv=None) -> int:
     print(f"[supervise] fleet run_id={run_id} "
           f"replicas={args.replicas} workdir={args.workdir}",
           file=sys.stderr)
-    rcs = [1] * args.replicas
+    rcs = {i: 1 for i in range(args.replicas)}
+    sups = {}
 
     def _one(i: int) -> None:
         cfg = build_cfg(os.path.join(args.workdir, f"replica-{i}"),
                         run_id, i)
+        sups[i] = Supervisor(cfg)
         try:
-            rcs[i] = Supervisor(cfg).run()
+            rcs[i] = sups[i].run()
         except Exception as e:  # noqa: BLE001 - one replica's failure
             print(f"[supervise] replica {i} supervisor died: {e!r}",
                   file=sys.stderr)
@@ -123,9 +276,9 @@ def main(argv=None) -> int:
         t.start()
     for t in threads:
         t.join()
-    print(f"[supervise] fleet done run_id={run_id} rcs={rcs}",
-          file=sys.stderr)
-    return max(rcs)
+    outcomes = {i: (sups[i].final_outcome if i in sups else None)
+                for i in range(args.replicas)}
+    return _classified_exit(outcomes, rcs, run_id)
 
 
 if __name__ == "__main__":
