@@ -409,6 +409,52 @@ def _controller_status():
     }
 
 
+def _resilience_status():
+    """Data-plane resilience smoke (host-only, no device, no sockets):
+    the three properties the chaos soak rests on — the retry budget
+    bounds retry amplification, the breaker walks closed→open→half-open
+    →closed, and a chaos seed expands to a byte-identical fault
+    schedule — exercised through the real primitives."""
+    from deeplearning_tpu.elastic import faults
+    from deeplearning_tpu.fleet.resilience import (CircuitBreaker,
+                                                   RetryBudget)
+
+    t0 = time.perf_counter()
+
+    budget = RetryBudget(fraction=0.5, cap=4.0, initial=1.0)
+    budget_ok = budget.try_spend() and not budget.try_spend()
+    budget.note_success()          # +0.5: still under a whole token
+    budget_ok = budget_ok and not budget.try_spend()
+    budget.note_success()
+    budget_ok = budget_ok and budget.try_spend()
+
+    clock = [0.0]
+    br = CircuitBreaker(window=8, failure_threshold=0.5, min_samples=2,
+                        reset_timeout_s=5.0, clock=lambda: clock[0])
+    br.record(False)
+    br.record(False)
+    tripped = br.state == "open" and not br.allow()
+    clock[0] = 6.0
+    probe = br.allow()             # past cooldown: the half-open probe
+    single_probe = not br.allow()  # one probe at a time
+    br.record(True)
+    breaker_ok = (tripped and probe and single_probe
+                  and br.state == "closed")
+
+    spec = "7:e503*3@0-50;latency:40*2@10-60;wedge:1*1@20-80"
+    a, b = faults.chaos_schedule(spec), faults.chaos_schedule(spec)
+    chaos_ok = (a == b and a != "" and len(a.split(";")) == 6
+                and a != faults.chaos_schedule("8:" + spec.split(":", 1)[1]))
+
+    return {
+        "clean": bool(budget_ok and breaker_ok and chaos_ok),
+        "budget_ok": bool(budget_ok),
+        "breaker_ok": bool(breaker_ok),
+        "chaos_deterministic": bool(chaos_ok),
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _lint_status():
     """dltpu-check ratchet verdict for the bench record: a perf number
     from a tree with NEW policy findings (a stray hot-loop sync, a
@@ -502,6 +548,11 @@ def _health_probe():
             cpu_fallback["controller_clean"] = _controller_status()
         except Exception as e:  # noqa: BLE001 - fallback best-effort
             cpu_fallback["controller_clean"] = {"error": repr(e)}
+        progress[0] += 1
+        try:
+            cpu_fallback["resilience_clean"] = _resilience_status()
+        except Exception as e:  # noqa: BLE001 - fallback best-effort
+            cpu_fallback["resilience_clean"] = {"error": repr(e)}
         progress[0] += 1
         print(json.dumps({
             "metric": "vit_b16_train_mfu", "value": 0.0, "unit": "%",
@@ -656,6 +707,11 @@ def main():
         rec["controller_clean"] = _controller_status()
     except Exception as e:  # noqa: BLE001 - smoke is best-effort
         rec["controller_clean"] = {"error": repr(e)}
+    try:
+        # data-plane resilience smoke: budget/breaker/chaos-seed behave
+        rec["resilience_clean"] = _resilience_status()
+    except Exception as e:  # noqa: BLE001 - smoke is best-effort
+        rec["resilience_clean"] = {"error": repr(e)}
     print(json.dumps(rec))
     _record_good({**rec, "utc": time.strftime("%Y-%m-%d %H:%M:%S",
                                               time.gmtime())})

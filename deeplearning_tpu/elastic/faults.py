@@ -58,35 +58,71 @@ preempts exactly one of them:
   site; the serving CLI reacts exactly as a real SIGTERM-with-grace
   preemption would — drain, then exit 75 — so the controller's
   preemption-as-capacity path runs for real.
+
+The resilience-layer kinds extend the consumed family to the serving
+data plane (all polled by the ``MicroBatcher`` against its
+``dispatched`` counter):
+
+- ``e503@submit:N``: the serve CLI answers one request with an injected
+  503 once ``dispatched`` reaches N — exercises router failover and the
+  per-replica circuit breaker without any replica actually failing.
+- ``latency:<ms>@step:N``: the dispatch loop sleeps ``ms`` before one
+  batch — injected tail latency, the stimulus the router's hedging
+  policy exists to absorb.
+- ``crash_replica:<i>@step:N``: replica ``i`` hard-exits (non-75,
+  non-0) mid-serve, so the supervisor classifies a crash and in-flight
+  requests surface as connection errors to the router.
+
+``DLTPU_CHAOS=<seed>:<spec>`` compiles a *deterministic* schedule of
+the above through :func:`chaos_schedule` (same seed → byte-identical
+schedule), e.g. ``DLTPU_CHAOS="7:e503*20@5-40;latency:50*10@5-40;
+wedge:1*1@10-30"`` — each token is ``kind[:target]*count@lo-hi`` and
+expands to ``count`` specs in the regular grammar with step ordinals
+drawn from ``[lo, hi]``. :func:`active_faults` merges the compiled
+schedule with any explicit ``DLTPU_FAULTS`` specs.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import signal
 import time
 from typing import List, Optional
 
-__all__ = ["ENV_VAR", "ATTEMPT_VAR", "REPLICA_VAR", "FaultSpec",
-           "InjectedCrash", "InjectedBadSample", "parse_faults",
-           "active_faults", "maybe_fire", "consume",
+__all__ = ["ENV_VAR", "ATTEMPT_VAR", "REPLICA_VAR", "CHAOS_VAR",
+           "FaultSpec", "InjectedCrash", "InjectedBadSample",
+           "parse_faults", "chaos_schedule", "active_faults",
+           "maybe_fire", "consume", "consume_arg",
            "corrupt_checkpoint", "reset"]
 
 ENV_VAR = "DLTPU_FAULTS"
 ATTEMPT_VAR = "DLTPU_RESTART_ATTEMPT"
+CHAOS_VAR = "DLTPU_CHAOS"
 
 _KINDS = ("sigterm", "sigint", "crash", "wedge",
           "nan", "bad_sample", "ckpt_corrupt",
-          "wedge_replica", "preempt_replica")
+          "wedge_replica", "preempt_replica",
+          "e503", "latency", "crash_replica")
 # kinds applied by their owning subsystem via consume(); maybe_fire
 # skips them so the generic step/checkpoint hooks can't double-deliver
 _CONSUMED_KINDS = ("nan", "bad_sample", "ckpt_corrupt",
-                   "wedge_replica", "preempt_replica")
+                   "wedge_replica", "preempt_replica",
+                   "e503", "latency", "crash_replica")
 # kinds whose token carries a target replica index (kind:<i>) matched
 # against DLTPU_REPLICA — one shared fault var, one afflicted replica
-_REPLICA_KINDS = ("wedge_replica", "preempt_replica")
-_SITES = ("step", "checkpoint")
+_REPLICA_KINDS = ("wedge_replica", "preempt_replica", "crash_replica")
+# kinds whose token carries a numeric argument (kind:<value>)
+_ARG_KINDS = ("latency",)
+_SITES = ("step", "checkpoint", "submit")
 REPLICA_VAR = "DLTPU_REPLICA"
+
+# chaos token kind → the regular-grammar kind/site it expands to
+_CHAOS_KINDS = {"e503": ("e503", "submit"),
+                "latency": ("latency", "step"),
+                "wedge": ("wedge_replica", "step"),
+                "preempt": ("preempt_replica", "step"),
+                "crash": ("crash_replica", "step")}
 
 # long enough that only the supervisor's wedge kill ends it, short
 # enough that an escaped sleep can't outlive a test suite timeout
@@ -104,20 +140,26 @@ class InjectedBadSample(ValueError):
 
 
 class FaultSpec:
-    __slots__ = ("kind", "site", "at_step", "attempt", "replica", "fired")
+    __slots__ = ("kind", "site", "at_step", "attempt", "replica", "arg",
+                 "fired")
 
     def __init__(self, kind: str, site: str, at_step: Optional[int],
-                 attempt: Optional[int], replica: Optional[int] = None):
+                 attempt: Optional[int], replica: Optional[int] = None,
+                 arg: Optional[float] = None):
         self.kind = kind
         self.site = site
         self.at_step = at_step
         self.attempt = attempt
         self.replica = replica
+        self.arg = arg
         self.fired = False
 
     def __repr__(self) -> str:  # shows up in flight events / test output
-        kind = (self.kind if self.replica is None
-                else f"{self.kind}:{self.replica}")
+        kind = self.kind
+        if self.replica is not None:
+            kind = f"{kind}:{self.replica}"
+        elif self.arg is not None:
+            kind = f"{kind}:{self.arg:g}"
         parts = [kind, self.site if self.at_step is None
                  else f"{self.site}:{self.at_step}"]
         if self.attempt is not None:
@@ -148,12 +190,17 @@ def parse_faults(text: str) -> List[FaultSpec]:
         kind, _, target = fields[0].lower().partition(":")
         if kind not in _KINDS:
             continue
-        replica = None
+        replica, arg = None, None
         if kind in _REPLICA_KINDS:
             try:
                 replica = int(target)
             except ValueError:
                 continue               # replica kinds require a target
+        elif kind in _ARG_KINDS:
+            try:
+                arg = float(target)
+            except ValueError:
+                continue               # arg kinds require a value
         elif target:
             continue                   # "sigterm:3" is not grammar
         site, at_step, attempt = "step", None, None
@@ -176,8 +223,55 @@ def parse_faults(text: str) -> List[FaultSpec]:
             else:
                 ok = False
         if ok:
-            specs.append(FaultSpec(kind, site, at_step, attempt, replica))
+            specs.append(FaultSpec(kind, site, at_step, attempt, replica,
+                                   arg))
     return specs
+
+
+def chaos_schedule(text: str) -> str:
+    """Compile ``DLTPU_CHAOS="<seed>:<token>;<token>..."`` into a
+    regular-grammar fault string. Each token is
+    ``kind[:target]*count@lo-hi`` (``count`` defaults to 1, range to
+    ``0-0``); kinds: ``e503``, ``latency:<ms>``, ``wedge:<i>``,
+    ``preempt:<i>``, ``crash:<i>``. Pure and deterministic — one
+    ``random.Random(seed)`` consumed in token order, so the same seed
+    yields a byte-identical schedule on every run (replayable chaos).
+    Malformed input compiles to ``""``, never raises."""
+    seed_s, sep, body = text.partition(":")
+    if not sep:
+        return ""
+    try:
+        rng = random.Random(int(seed_s))
+    except ValueError:
+        return ""
+    out: List[str] = []
+    for token in body.split(";"):
+        token = token.strip()
+        if not token:
+            continue
+        head, _, rng_s = token.partition("@")
+        name, _, count_s = head.partition("*")
+        kind, _, target = name.strip().lower().partition(":")
+        if kind not in _CHAOS_KINDS:
+            continue
+        real_kind, site = _CHAOS_KINDS[kind]
+        if real_kind in _REPLICA_KINDS or real_kind in _ARG_KINDS:
+            if not target:
+                continue               # wedge/preempt/crash/latency need one
+            real_kind = f"{real_kind}:{target}"
+        elif target:
+            continue
+        try:
+            count = int(count_s) if count_s else 1
+            lo_s, _, hi_s = (rng_s or "0-0").partition("-")
+            lo, hi = int(lo_s), int(hi_s or lo_s)
+        except ValueError:
+            continue
+        if count < 1 or hi < lo:
+            continue
+        steps = sorted(rng.randint(lo, hi) for _ in range(count))
+        out.extend(f"{real_kind}@{site}:{s}" for s in steps)
+    return ";".join(out)
 
 
 _SPECS: Optional[List[FaultSpec]] = None
@@ -186,7 +280,11 @@ _SPECS: Optional[List[FaultSpec]] = None
 def active_faults() -> List[FaultSpec]:
     global _SPECS
     if _SPECS is None:
-        _SPECS = parse_faults(os.environ.get(ENV_VAR, ""))
+        specs = parse_faults(os.environ.get(ENV_VAR, ""))
+        chaos = os.environ.get(CHAOS_VAR, "")
+        if chaos:
+            specs.extend(parse_faults(chaos_schedule(chaos)))
+        _SPECS = specs
     return _SPECS
 
 
@@ -226,14 +324,10 @@ def maybe_fire(site: str, step: int = 0) -> None:
         return
 
 
-def consume(kind: str, site: str, step: int = 0) -> bool:
-    """Poll-style faults: True once when a matching un-fired spec of
-    ``kind`` exists — the CALLER owns the effect (poison params, raise a
-    decode error, garble a step dir), so the fault flows through the
-    same code path a real failure would."""
+def _consume_spec(kind: str, site: str, step: int) -> Optional[FaultSpec]:
     specs = active_faults()
     if not specs:
-        return False
+        return None
     attempt = current_attempt()
     for spec in specs:
         if spec.kind != kind or not spec.matches(site, step, attempt):
@@ -241,8 +335,26 @@ def consume(kind: str, site: str, step: int = 0) -> bool:
         spec.fired = True
         from ..obs import flight
         flight.record("fault_injected", fault=repr(spec), step=int(step))
-        return True
-    return False
+        return spec
+    return None
+
+
+def consume(kind: str, site: str, step: int = 0) -> bool:
+    """Poll-style faults: True once when a matching un-fired spec of
+    ``kind`` exists — the CALLER owns the effect (poison params, raise a
+    decode error, garble a step dir), so the fault flows through the
+    same code path a real failure would."""
+    return _consume_spec(kind, site, step) is not None
+
+
+def consume_arg(kind: str, site: str, step: int = 0) -> Optional[float]:
+    """Like :func:`consume` for arg-carrying kinds (``latency:<ms>``):
+    returns the spec's numeric argument once, ``None`` when nothing
+    matches."""
+    spec = _consume_spec(kind, site, step)
+    if spec is None:
+        return None
+    return spec.arg if spec.arg is not None else 0.0
 
 
 def corrupt_checkpoint(directory: str, step: int,

@@ -23,12 +23,28 @@ Preemption (exit 75) short-circuits the cadence: the supervisor's
 policy answers replace-or-shed immediately — ``"requeue_now"`` skips
 the backoff curve entirely, ``"stop"`` folds the capacity.
 
+**Warm standbys** (PR 15): with ``standby_target > 0`` the controller
+keeps that many spares fully warmed but unroutable (spawned with
+``DLTPU_STANDBY=1``; ``/healthz`` says 503 "standby"). Losing capacity
+— a wedge, a preemption the policy votes to replace, a scale-up —
+*promotes* a standby (``POST /admin/promote``: a healthz flip, no
+compile, no process start) instead of cold-spawning, then replenishes
+the spare pool in the background. Promotion latency is one HTTP
+round-trip; cold spawn is a process launch plus full engine warmup.
+
+**Tenant brownout** (PR 15): per-model SLO verdicts from the rollup
+feed :meth:`~.policy.FleetPolicy.brownout_observe`; when a tenant's
+ladder moves, the new step is pushed to every live replica via
+``POST /admin/brownout/<model>/<step>`` — degrade one tenant (largest-
+bucket-only → int8 residency → partial shed) before dimming the fleet.
+
 Every decision lands twice: in the controller's own flight ring
 (dumped to ``<run_dir>/flightrec_controller.json`` — the file
 ``tools/obs_report.py`` renders the fleet-controller section from) and
 in the process-global ring next to the ``slo_breach`` triggers, so
 cause and action interleave in one timeline. Events: ``fleet_scale``,
-``fleet_drain``, ``fleet_requeue``, ``preempt_capacity``.
+``fleet_drain``, ``fleet_requeue``, ``preempt_capacity``,
+``fleet_promote``, ``fleet_standby``, ``fleet_brownout``.
 """
 
 from __future__ import annotations
@@ -73,6 +89,7 @@ class FleetController:
                  interval_s: float = 1.0,
                  drain_deadline_s: float = 10.0,
                  scrape_timeout_s: float = 2.0,
+                 standby_target: int = 0,
                  fleet_path: Optional[str] = None):
         self.replica_set = replica_set
         self.policy = policy
@@ -90,15 +107,25 @@ class FleetController:
             config={"policy": policy.snapshot(),
                     "interval_s": self.interval_s,
                     "drain_deadline_s": self.drain_deadline_s})
+        self.standby_target = max(int(standby_target), 0)
         self.ticks = 0
         self.scale_ups = 0
         self.scale_downs = 0
         self.drains = 0
         self.requeues = 0
         self.preemptions = 0
+        self.promotions = 0
+        self.brownouts = 0
         # replicas mid-drain: index -> {"url", "t0", "then"} where
         # "then" is what happens when drained/deadline: restart | stop
         self._draining: Dict[int, Dict[str, Any]] = {}
+        # warm spares: indices spawned-as-standby and not yet promoted,
+        # plus the URLs the last scrape saw them advertise. Guarded by a
+        # lock because the preemption hook reads them from a supervisor
+        # thread while tick() writes them from the controller thread.
+        self._standby_lock = threading.Lock()
+        self._standby_indices: set = set()
+        self._standby_urls: Dict[int, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # wire preemption-as-capacity into every member's supervisor
@@ -121,14 +148,22 @@ class FleetController:
             self.run_dir, live_only=True)
         rollup = self.scraper.scrape_once()
         per_replica = rollup.get("per_replica") or []
+        self._sense_standbys(per_replica)
         self._heal(per_replica)
         self._finish_drains()
+        self._replenish_standbys()
+        self._drive_brownout(rollup, per_replica)
         # routable capacity: live supervisor slots minus mid-drain ones
+        # and minus warm spares (a standby is a promise, not capacity)
+        with self._standby_lock:
+            spares = set(self._standby_indices)
         live = len([i for i in self.replica_set.live()
-                    if i not in self._draining])
+                    if i not in self._draining and i not in spares])
         decision = self.policy.observe(rollup, live)
         if decision.action == "scale_up":
-            index = self.replica_set.spawn()
+            index = self._promote(decision.reason)
+            if index is None:
+                index = self.replica_set.spawn()
             self.scale_ups += 1
             self._record("fleet_scale", direction="up", replica=index,
                          reason=decision.reason, live=live,
@@ -152,8 +187,105 @@ class FleetController:
             index = _replica_index(row)
             if index is None or index in self._draining:
                 continue
-            self._begin_drain(index, row.get("url"), then="restart",
-                              reason="wedged")
+            # a warm spare covers the lost capacity NOW; the wedged
+            # replica then retires ("stop") instead of restarting. No
+            # spare → the original drain-and-requeue path.
+            promoted = self._promote("wedged")
+            self._begin_drain(
+                index, row.get("url"),
+                then=("stop" if promoted is not None else "restart"),
+                reason="wedged")
+
+    # --------------------------------------------------------- standby
+    def _sense_standbys(self, per_replica: List[Dict[str, Any]]) -> None:
+        """Refresh the spare map from the scrape: adopt any replica
+        advertising ``standby`` (supervise.py may have spawned the
+        initial spares before this controller existed) and remember its
+        URL — promotion needs an address, not just an index."""
+        live = set(self.replica_set.live())
+        with self._standby_lock:
+            self._standby_indices &= live
+            urls: Dict[int, str] = {}
+            for row in per_replica:
+                if row.get("status") != "standby":
+                    continue
+                index = _replica_index(row)
+                if index is None:
+                    continue
+                self._standby_indices.add(index)
+                url = row.get("url")
+                if url:
+                    urls[index] = url
+            self._standby_urls = urls
+
+    def _replenish_standbys(self) -> None:
+        live = set(self.replica_set.live())
+        with self._standby_lock:
+            have = len(self._standby_indices & live)
+            need = self.standby_target - have
+        for _ in range(max(need, 0)):
+            index = self.replica_set.spawn(standby=True)
+            with self._standby_lock:
+                self._standby_indices.add(index)
+            self._record("fleet_standby", replica=index,
+                         target=self.standby_target)
+
+    def _promote(self, reason: str) -> Optional[int]:
+        """Flip one warm spare to ready (``POST /admin/promote``);
+        returns its index, or None when no addressable spare exists or
+        every attempt failed. The promoted replica leaves the spare set
+        immediately — it is routable capacity from this moment."""
+        while True:
+            with self._standby_lock:
+                candidates = [(i, u) for i, u in
+                              sorted(self._standby_urls.items())
+                              if i in self._standby_indices]
+            if not candidates:
+                return None
+            index, url = candidates[0]
+            t0 = time.monotonic()
+            doc = _post_json(url.rstrip("/") + "/admin/promote",
+                             self.scrape_timeout_s)
+            with self._standby_lock:
+                self._standby_urls.pop(index, None)
+                self._standby_indices.discard(index)
+            if doc is not None and (doc.get("promoted")
+                                    or not doc.get("standby", True)):
+                self.promotions += 1
+                self._record(
+                    "fleet_promote", replica=index, url=url,
+                    reason=reason,
+                    seconds=round(time.monotonic() - t0, 4))
+                return index
+            # unreachable spare: drop it from the pool and try the next
+
+    # -------------------------------------------------------- brownout
+    def _drive_brownout(self, rollup: Dict[str, Any],
+                        per_replica: List[Dict[str, Any]]) -> None:
+        """Feed per-tenant SLO verdicts to the policy's ladders; push
+        every transition to all routable replicas so the whole fleet
+        dims (or undims) that tenant together."""
+        models = rollup.get("models") or {}
+        if not models:
+            return
+        urls = [row.get("url") for row in per_replica
+                if row.get("url") and row.get("status") != "standby"]
+        for alias in sorted(models):
+            verdict = models[alias].get("slo") or {}
+            step = self.policy.brownout_observe(
+                alias, bool(verdict.get("breach")))
+            if step is None:
+                continue
+            pushed = 0
+            for url in urls:
+                doc = _post_json(
+                    url.rstrip("/") + f"/admin/brownout/{alias}/{step}",
+                    self.scrape_timeout_s)
+                pushed += int(doc is not None)
+            self.brownouts += 1
+            self._record("fleet_brownout", model=alias, step=step,
+                         replicas=pushed,
+                         breach=bool(verdict.get("breach")))
 
     def _begin_drain(self, index: int, url: Optional[str], *,
                      then: str, reason: str) -> None:
@@ -200,8 +332,10 @@ class FleetController:
             i = _replica_index(row)
             if i is not None:
                 urls[i] = row.get("url")
+        with self._standby_lock:
+            spares = set(self._standby_indices)
         candidates = [i for i in self.replica_set.live()
-                      if i not in self._draining]
+                      if i not in self._draining and i not in spares]
         if not candidates:
             return None
         victim = max(candidates)
@@ -220,7 +354,13 @@ class FleetController:
                      attempt=attempt, verdict=verdict,
                      live_after=live_after)
         self.flight.dump("preempt_capacity", include_hbm=False)
-        return "requeue_now" if verdict == "replace" else "stop"
+        if verdict == "replace":
+            # a warm spare beats a requeue: promote it (one HTTP flip)
+            # and retire the preempted slot; replenish runs next tick
+            if self._promote("preempted") is not None:
+                return "stop"
+            return "requeue_now"
+        return "stop"
 
     def note_preemption(self, index: int) -> str:
         """Public flavor of the hook for callers that classify exits
@@ -253,10 +393,14 @@ class FleetController:
                            scale_ups=self.scale_ups,
                            scale_downs=self.scale_downs,
                            drains=self.drains, requeues=self.requeues,
-                           preemptions=self.preemptions)
+                           preemptions=self.preemptions,
+                           promotions=self.promotions,
+                           brownouts=self.brownouts)
         self.flight.dump("controller_stop", include_hbm=False)
 
     def summary(self) -> Dict[str, Any]:
+        with self._standby_lock:
+            standbys = sorted(self._standby_indices)
         return {
             "ticks": self.ticks,
             "scale_ups": self.scale_ups,
@@ -264,7 +408,10 @@ class FleetController:
             "drains": self.drains,
             "requeues": self.requeues,
             "preemptions": self.preemptions,
+            "promotions": self.promotions,
+            "brownouts": self.brownouts,
             "draining": sorted(self._draining),
+            "standbys": standbys,
             "live": self.replica_set.live(),
             "policy": self.policy.snapshot(),
         }

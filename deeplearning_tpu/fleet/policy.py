@@ -65,6 +65,12 @@ class FleetPolicy:
     Scale-down: ``idle_polls`` consecutive polls with (smoothed) empty
     queues, no breach, and per-replica QPS under ``idle_qps`` — and
     never below ``min_replicas``.
+
+    Tenant brownout (:meth:`brownout_observe`) is the same hysteresis
+    idea applied per model: sustained per-tenant SLO breach climbs a
+    degrade ladder one step at a time, sustained clean polls descend it
+    — so one tenant's overload dims that tenant before it dims the
+    fleet.
     """
 
     def __init__(self, *, min_replicas: int = 1, max_replicas: int = 8,
@@ -75,7 +81,10 @@ class FleetPolicy:
                  breach_polls: int = 3,
                  idle_polls: int = 6,
                  cooldown_s: float = 30.0,
-                 alpha: float = 0.2):
+                 alpha: float = 0.2,
+                 brownout_breach_polls: int = 2,
+                 brownout_clear_polls: int = 3,
+                 brownout_max_step: int = 3):
         if min_replicas < 0 or max_replicas < max(min_replicas, 1):
             raise ValueError(
                 f"bad bounds min={min_replicas} max={max_replicas}")
@@ -97,6 +106,11 @@ class FleetPolicy:
         self.idle_streak = 0
         self.decisions = 0
         self._last_action_at: Optional[float] = None
+        # tenant brownout ladders: {model: {step, breach, clear}}
+        self.brownout_breach_polls = int(brownout_breach_polls)
+        self.brownout_clear_polls = int(brownout_clear_polls)
+        self.brownout_max_step = int(brownout_max_step)
+        self._brownout: Dict[str, Dict[str, int]] = {}
 
     # -------------------------------------------------------- signals
     def _signals(self, rollup: Dict[str, Any],
@@ -179,6 +193,43 @@ class FleetPolicy:
         self.idle_streak = 0
         return Decision(action, reason, sig)
 
+    # ------------------------------------------------------- brownout
+    def brownout_observe(self, model: str,
+                         breach: bool) -> Optional[int]:
+        """Fold one per-tenant SLO verdict into that tenant's brownout
+        ladder. Same hysteresis shape as scaling: a breach must sustain
+        for ``brownout_breach_polls`` polls before the ladder climbs one
+        step, and the tenant must run clean for ``brownout_clear_polls``
+        polls before it descends one. Returns the NEW step when the
+        ladder moved, ``None`` when it held — so the controller only
+        actuates (and only records an event) on transitions. Steps:
+        1 = largest-bucket-only dispatch, 2 = + int8 residency,
+        3 = + shed a fraction of the tenant's lane."""
+        st = self._brownout.setdefault(
+            model, {"step": 0, "breach": 0, "clear": 0})
+        if breach:
+            st["breach"] += 1
+            st["clear"] = 0
+            if st["breach"] >= self.brownout_breach_polls \
+                    and st["step"] < self.brownout_max_step:
+                st["step"] += 1
+                st["breach"] = 0
+                return st["step"]
+        else:
+            st["clear"] += 1
+            st["breach"] = 0
+            if st["clear"] >= self.brownout_clear_polls \
+                    and st["step"] > 0:
+                st["step"] -= 1
+                st["clear"] = 0
+                return st["step"]
+        return None
+
+    def brownout_steps(self) -> Dict[str, int]:
+        """Current non-zero ladder positions, ``{model: step}``."""
+        return {m: st["step"] for m, st in self._brownout.items()
+                if st["step"] > 0}
+
     # ----------------------------------------------------- preemption
     def on_preemption(self, live_after: int) -> str:
         """Exit-75 verdict: ``"replace"`` (requeue the replica now) or
@@ -208,4 +259,7 @@ class FleetPolicy:
             "queue_per_replica": round(self.queue_per_replica.value, 3),
             "error_burn": round(self.error_burn.value, 5),
             "qps_per_replica": round(self.qps_per_replica.value, 3),
+            "brownout_breach_polls": self.brownout_breach_polls,
+            "brownout_clear_polls": self.brownout_clear_polls,
+            "brownout_steps": self.brownout_steps(),
         }

@@ -29,11 +29,13 @@ __all__ = ["ReplicaSet"]
 class ReplicaSet:
     """``config_factory(index) -> SupervisorConfig`` builds each
     member's supervisor config (argv, workdir ``replica-<i>/``, env —
-    ``tools/supervise.py`` owns that recipe). ``on_outcome``, when set,
-    is called as ``on_outcome(index, supervisor, outcome, attempt, rc)``
-    for every natural child ending and may return the supervisor hints
-    (``"requeue_now"``/``"stop"``) — the controller's
-    preemption-as-capacity hook."""
+    ``tools/supervise.py`` owns that recipe); a factory accepting a
+    second ``standby`` argument lets ``spawn(standby=True)`` build
+    warm-spare configs (``DLTPU_STANDBY=1`` in the child env).
+    ``on_outcome``, when set, is called as ``on_outcome(index,
+    supervisor, outcome, attempt, rc)`` for every natural child ending
+    and may return the supervisor hints (``"requeue_now"``/``"stop"``)
+    — the controller's preemption-as-capacity hook."""
 
     def __init__(self, config_factory: Callable[[int], SupervisorConfig],
                  *, on_outcome: Optional[Callable[..., Optional[str]]]
@@ -45,8 +47,12 @@ class ReplicaSet:
         self.on_outcome = on_outcome
 
     # ----------------------------------------------------------- spawn
-    def spawn(self, index: Optional[int] = None) -> int:
-        """Add (and start) one supervised replica; returns its index."""
+    def spawn(self, index: Optional[int] = None, *,
+              standby: bool = False) -> int:
+        """Add (and start) one supervised replica; returns its index.
+        ``standby=True`` asks the factory for a warm-spare config (the
+        factory must accept ``(index, standby)`` — single-arg factories
+        keep working for regular spawns)."""
         with self._lock:
             if index is None:
                 index = self._next_index
@@ -55,7 +61,9 @@ class ReplicaSet:
             if existing is not None and existing["thread"].is_alive():
                 raise ValueError(f"replica {index} already running")
 
-        sup = Supervisor(self._factory(index))
+        config = (self._factory(index, True) if standby
+                  else self._factory(index))
+        sup = Supervisor(config)
         if self.on_outcome is not None:
             def _hook(_sup, outcome, attempt, rc, _i=index):
                 return self.on_outcome(_i, _sup, outcome, attempt, rc)

@@ -1,0 +1,208 @@
+"""Resilience primitives for the fleet data plane: retry budgets and
+per-replica circuit breakers.
+
+The router's failover loop (``fleet/router.py``) is where an outage can
+*amplify*: every failed request that retries adds load to the replicas
+still standing, and a replica that keeps failing keeps eating one
+attempt per request until the next health refresh notices. These two
+classes bound both failure modes, client-side and allocation-free:
+
+- :class:`RetryBudget` is a token bucket fed by *successes*: each
+  success deposits ``fraction`` tokens, each retry (or hedge) withdraws
+  one. With every replica down there are no deposits, so total attempts
+  are capped at ``(1 + fraction) x offered load`` plus the configured
+  burst — a retry storm cannot multiply an outage (the classic
+  retry-budget rule from the SRE literature).
+- :class:`CircuitBreaker` tracks a per-replica sliding window of
+  attempt outcomes: too many failures trips it OPEN (the router skips
+  the replica *between* health refreshes, closing the staleness
+  window), a cooldown later it goes HALF_OPEN and admits exactly one
+  probe — success re-closes it, failure re-opens with a fresh cooldown.
+
+Both are thread-safe (the router posts from many loadgen sender
+threads and from hedge workers) and host-side only — stdlib, no jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+__all__ = ["RetryBudget", "CircuitBreaker"]
+
+
+class RetryBudget:
+    """Token bucket that caps retries as a fraction of recent successes.
+
+    ``note_success()`` deposits ``fraction`` tokens (clamped to
+    ``cap``); ``try_spend()`` withdraws one token per retry/hedge and
+    refuses when the bucket is empty; ``give_back()`` refunds the token
+    of an abandoned hedge (the loser's attempt never cost the fleet a
+    full request, so it should not cost the budget one either).
+    ``initial`` seeds the bucket so a cold client can still retry a
+    transient blip before its first success.
+    """
+
+    def __init__(self, fraction: float = 0.2, cap: float = 10.0,
+                 initial: float = 0.0):
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        self.fraction = float(fraction)
+        self.cap = float(cap)
+        self._lock = threading.Lock()
+        self._tokens = min(float(initial), self.cap)
+        self.successes = 0
+        self.spent = 0
+        self.refunded = 0
+        self.exhausted = 0
+
+    def note_success(self) -> None:
+        with self._lock:
+            self.successes += 1
+            self._tokens = min(self._tokens + self.fraction, self.cap)
+
+    def try_spend(self) -> bool:
+        """Withdraw one token; False (and no withdrawal) when empty."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent += 1
+                return True
+            self.exhausted += 1
+            return False
+
+    def give_back(self) -> None:
+        """Refund one token (abandoned hedge loser)."""
+        with self._lock:
+            self.refunded += 1
+            self._tokens = min(self._tokens + 1.0, self.cap)
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"tokens": round(self._tokens, 3),
+                    "fraction": self.fraction,
+                    "successes": self.successes, "spent": self.spent,
+                    "refunded": self.refunded,
+                    "exhausted": self.exhausted}
+
+
+class CircuitBreaker:
+    """Per-replica failure-rate breaker: CLOSED -> OPEN -> HALF_OPEN.
+
+    Outcomes land in a sliding window of the last ``window`` attempts.
+    Once at least ``min_samples`` are present and the failure rate
+    reaches ``failure_threshold`` the breaker OPENs: ``allow()`` turns
+    False, so the router drops the replica from rotation immediately —
+    no waiting for the next ``/healthz`` refresh to notice. After
+    ``reset_timeout_s`` the breaker admits exactly one probe
+    (HALF_OPEN): a success re-closes it with a cleared window, a
+    failure re-opens it with a fresh cooldown.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, window: int = 12, failure_threshold: float = 0.5,
+                 min_samples: int = 4, reset_timeout_s: float = 2.0,
+                 clock=time.monotonic):
+        self.window = int(window)
+        self.failure_threshold = float(failure_threshold)
+        self.min_samples = int(min_samples)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._outcomes: List[bool] = []
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        self.opens = 0
+        self.closes = 0
+        self.probes = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May the router send this replica a request right now?
+
+        OPEN past its cooldown transitions to HALF_OPEN and admits the
+        single probe attempt; further callers are refused until that
+        probe's outcome lands in :meth:`record`.
+        """
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.reset_timeout_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probing = True
+                self.probes += 1
+                return True
+            # HALF_OPEN: exactly one probe in flight at a time
+            if self._probing:
+                return False
+            self._probing = True
+            self.probes += 1
+            return True
+
+    def blocking(self) -> bool:
+        """Non-consuming peek: would :meth:`allow` refuse right now?
+        (Listing candidate targets must not eat the half-open probe
+        slot — only an actual send may.)"""
+        with self._lock:
+            if self._state == self.OPEN:
+                return (self._clock() - self._opened_at
+                        < self.reset_timeout_s)
+            if self._state == self.HALF_OPEN:
+                return self._probing
+            return False
+
+    def release(self) -> None:
+        """Un-consume a half-open probe slot when the admitted attempt
+        was never actually sent (deadline or retry budget refused it) —
+        the probe must stay available for the next real send."""
+        with self._lock:
+            if self._state == self.HALF_OPEN and self._probing:
+                self._probing = False
+                self.probes -= 1
+
+    def record(self, ok: bool) -> None:
+        """Land an attempt outcome (429-shedding is NOT a failure — the
+        replica answered; the caller classifies before recording)."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._probing = False
+                if ok:
+                    self._state = self.CLOSED
+                    self._outcomes = []
+                    self.closes += 1
+                else:
+                    self._state = self.OPEN
+                    self._opened_at = self._clock()
+                return
+            self._outcomes.append(bool(ok))
+            if len(self._outcomes) > self.window:
+                del self._outcomes[: len(self._outcomes) - self.window]
+            if self._state == self.CLOSED:
+                n = len(self._outcomes)
+                fails = n - sum(self._outcomes)
+                if (n >= self.min_samples
+                        and fails / n >= self.failure_threshold):
+                    self._state = self.OPEN
+                    self._opened_at = self._clock()
+                    self.opens += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._outcomes)
+            return {"state": self._state, "samples": n,
+                    "failures": n - sum(self._outcomes),
+                    "opens": self.opens, "closes": self.closes,
+                    "probes": self.probes}

@@ -6,10 +6,27 @@ honoring 503s; in this repo (and its tier-1 choreography test) it is
 this stdlib router: round-robin over replicas whose last ``/healthz``
 read was routable (``ready``/``warming``/``degraded`` — states that
 still answer), with failover on refusal. A replica reporting
-``draining``/``wedged``/unreachable is skipped at the health refresh,
-and a request that still lands on one (the refresh is periodic, not
-clairvoyant) fails over to the next distinct replica instead of
-surfacing the 503/connection error to the caller.
+``draining``/``wedged``/``standby``/unreachable is skipped at the
+health refresh, and a request that still lands on one (the refresh is
+periodic, not clairvoyant) fails over to the next distinct replica.
+
+On top of plain failover sits the resilience layer
+(``fleet/resilience.py``):
+
+- **Deadlines**: ``post_ex(..., deadline_s=...)`` stamps the remaining
+  budget into an ``X-Deadline-Ms`` header on every attempt and never
+  retries or hedges past it — the serve side maps the header onto its
+  admission deadline, so the whole chain spends one budget.
+- **Retry budget**: every attempt beyond the first withdraws a token
+  from a :class:`RetryBudget` fed by successes, so a fleet-wide outage
+  cannot be amplified into a retry storm.
+- **Hedging**: when the first attempt is slower than the observed p99,
+  one token buys a second attempt at a distinct replica; first answer
+  wins, the loser is abandoned and (when the primary won) the token is
+  refunded.
+- **Circuit breakers**: per-replica failure windows open a breaker that
+  removes the replica from rotation *between* health refreshes;
+  half-open probes re-admit it.
 
 Host-side only — urllib, no jax — usable from ``tools/loadgen.py``
 (HTTP open-loop mode) and tests.
@@ -22,14 +39,28 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
+from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["FleetRouter"]
+from ..obs import threads as obs_threads
+from .resilience import CircuitBreaker, RetryBudget
+
+__all__ = ["FleetRouter", "DEADLINE_HEADER"]
 
 # healthz statuses a request may still be sent to: a warming replica
-# queues (slowly), a degraded one sheds but answers; draining and
-# wedged ones must see no NEW traffic
+# queues (slowly), a degraded one sheds but answers; draining, wedged,
+# and standby ones must see no NEW traffic
 _ROUTABLE = ("ready", "warming", "degraded")
+
+# outcome codes that dent a replica's breaker: connection-dead, server
+# errors, timeouts. 429 is the admission controller *answering* —
+# shedding is load, not replica failure.
+_FAILURE_CODES = (0, 500, 503, 504)
+# codes worth spending budget on at another replica
+_RETRYABLE = (0, 429, 503, 504)
+
+DEADLINE_HEADER = "X-Deadline-Ms"
 
 
 class FleetRouter:
@@ -40,19 +71,35 @@ class FleetRouter:
     def __init__(self, urls: Sequence[str] = (), *,
                  refresh_fn=None,
                  health_ttl_s: float = 0.5,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 budget: Optional[RetryBudget] = None,
+                 breaker_factory=CircuitBreaker,
+                 hedge: bool = True,
+                 hedge_delay_s: float = 0.25):
         self._urls = [u.rstrip("/") for u in urls]
         self._refresh_fn = refresh_fn
         self.health_ttl_s = float(health_ttl_s)
         self.timeout_s = float(timeout_s)
+        self.budget = budget if budget is not None else RetryBudget(
+            fraction=0.2, cap=10.0, initial=2.0)
+        self._breaker_factory = breaker_factory
+        self.hedge = bool(hedge)
+        self.hedge_delay_s = float(hedge_delay_s)
         self._lock = threading.Lock()
         self._rr = 0
         self._status: Dict[str, str] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._latencies: deque = deque(maxlen=128)   # successful e2e s
         self._checked_at = 0.0
         self.sent = 0
         self.failovers = 0
         self.no_route = 0
         self.refresh_errors = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.deadline_misses = 0
+        self.breaker_skips = 0
+        self.all_shed = 0
         self.last_refresh_error: Optional[str] = None
 
     # ---------------------------------------------------------- health
@@ -78,67 +125,331 @@ class FleetRouter:
             if not stale:
                 return
             self._checked_at = now
+            urls = list(self._urls)
         if self._refresh_fn is not None:
             try:
-                self._urls = [u.rstrip("/")
-                              for u in self._refresh_fn()]
+                urls = [u.rstrip("/") for u in self._refresh_fn()]
             except Exception as e:  # noqa: BLE001 - keep the last set
-                self.refresh_errors += 1
-                self.last_refresh_error = repr(e)
-        status = {u: self._healthz(u) for u in list(self._urls)}
+                with self._lock:
+                    self.refresh_errors += 1
+                    self.last_refresh_error = repr(e)
+        status = {u: self._healthz(u) for u in urls}
         with self._lock:
+            self._urls = urls
             self._status = status
 
+    def _breaker(self, url: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(url)
+            if br is None:
+                br = self._breakers[url] = self._breaker_factory()
+            return br
+
     def routable(self) -> List[str]:
+        """URLs fit to receive a request now: healthz-routable AND not
+        sitting behind an open circuit breaker (the breaker acts
+        between health refreshes; ``blocking()`` is non-consuming, so
+        listing targets never eats a half-open probe slot)."""
         self._refresh()
         with self._lock:
-            return [u for u in self._urls
+            urls = [u for u in self._urls
                     if self._status.get(u) in _ROUTABLE]
+            breakers = [self._breakers.get(u) for u in urls]
+        return [u for u, br in zip(urls, breakers)
+                if br is None or not br.blocking()]
 
     def statuses(self) -> Dict[str, str]:
         self._refresh()
         with self._lock:
             return dict(self._status)
 
+    # ------------------------------------------------------------ obs
+    def observed_p99_s(self) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+        if len(lat) < 8:
+            return None
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    def _hedge_delay(self) -> float:
+        p99 = self.observed_p99_s()
+        return max(p99, 0.01) if p99 is not None else self.hedge_delay_s
+
+    def resilience_stats(self) -> Dict[str, Any]:
+        """One fold of the whole layer — what loadgen dumps next to its
+        timeline and the soak e2e gates on."""
+        with self._lock:
+            breakers = dict(self._breakers)
+            out: Dict[str, Any] = {
+                "sent": self.sent, "failovers": self.failovers,
+                "no_route": self.no_route,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "deadline_misses": self.deadline_misses,
+                "breaker_skips": self.breaker_skips,
+                "all_shed": self.all_shed,
+            }
+        snaps = {u: br.snapshot() for u, br in sorted(breakers.items())}
+        out["budget"] = self.budget.snapshot()
+        out["breakers"] = snaps
+        out["breaker_opens"] = sum(s["opens"] for s in snaps.values())
+        out["breaker_closes"] = sum(s["closes"] for s in snaps.values())
+        return out
+
     # ----------------------------------------------------------- send
     def post(self, path: str, body: bytes,
              headers: Optional[Dict[str, str]] = None
              ) -> Tuple[int, Any, Optional[str]]:
         """POST ``body`` to ``path`` on the next routable replica,
-        failing over through every distinct routable replica on
-        connection errors / 503 / 429 before giving up. Returns
+        failing over through distinct routable replicas on connection
+        errors / 503 / 429 before giving up. Returns
         ``(status_code, payload, url)``; ``(0, None, None)`` when no
         replica is routable at all."""
+        code, payload, url, _ = self.post_ex(path, body, headers)
+        return code, payload, url
+
+    def post_ex(self, path: str, body: bytes,
+                headers: Optional[Dict[str, str]] = None, *,
+                deadline_s: Optional[float] = None,
+                hedge: Optional[bool] = None
+                ) -> Tuple[int, Any, Optional[str], Dict[str, Any]]:
+        """:meth:`post` with the resilience layer surfaced: returns
+        ``(code, payload, url, meta)`` where ``meta`` counts what the
+        layer did for this one request (attempts/retries/hedge/deadline
+        verdicts). With ``deadline_s`` every attempt carries the
+        *remaining* budget in ``X-Deadline-Ms`` and no retry or hedge
+        is launched past it."""
+        t0 = time.monotonic()
+        deadline = t0 + deadline_s if deadline_s else None
+        meta: Dict[str, Any] = {
+            "attempts": 0, "retries": 0, "hedged": False,
+            "hedge_won": False, "deadline_miss": False,
+            "budget_exhausted": False, "no_route": False,
+            "retry_after_s": None, "all_shed": False}
+        do_hedge = self.hedge if hedge is None else bool(hedge)
+
         targets = self.routable()
         if not targets:
             self._refresh(force=True)
             targets = self.routable()
         if not targets:
-            self.no_route += 1
-            return 0, None, None
+            with self._lock:
+                self.no_route += 1
+            meta["no_route"] = True
+            return 0, None, None, meta
         with self._lock:
             start = self._rr % len(targets)
             self._rr += 1
+        order = [targets[(start + i) % len(targets)]
+                 for i in range(len(targets))]
+
+        hints: List[float] = []      # retry_after_s from 429 bodies
+        codes: List[int] = []
         last: Tuple[int, Any, Optional[str]] = (0, None, None)
-        for i in range(len(targets)):
-            url = targets[(start + i) % len(targets)]
-            code, payload = self._post_one(url + path, body, headers)
-            if code not in (0, 429, 503):
-                self.sent += 1
+
+        def remaining() -> Optional[float]:
+            return None if deadline is None else deadline - time.monotonic()
+
+        def admit(first: bool) -> bool:
+            """May another attempt launch? Spends budget past the first."""
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                meta["deadline_miss"] = True
+                return False
+            if not first and not self.budget.try_spend():
+                meta["budget_exhausted"] = True
+                return False
+            return True
+
+        def settle(code: int, payload: Any, url: str
+                   ) -> Optional[Tuple[int, Any, Optional[str]]]:
+            """Fold one attempt outcome; non-None means return it."""
+            codes.append(code)
+            if code == 429 and isinstance(payload, dict):
+                try:
+                    hints.append(float(payload["retry_after_s"]))
+                except (KeyError, TypeError, ValueError):
+                    pass
+            if code not in _RETRYABLE:
+                self.budget.note_success()
+                with self._lock:
+                    self.sent += 1
                 return code, payload, url
-            last = (code, payload, url)
-            self.failovers += 1
-        return last
+            with self._lock:
+                self.failovers += 1
+            return None
+
+        idx = 0
+        first_attempt = True
+        while idx < len(order):
+            url = order[idx]
+            idx += 1
+            br = self._breaker(url)
+            if not br.allow():
+                with self._lock:
+                    self.breaker_skips += 1
+                continue
+            if not admit(first_attempt):
+                br.release()     # never sent; free the probe slot
+                break
+            if not first_attempt:
+                meta["retries"] += 1
+            meta["attempts"] += 1
+            hedged_here = (first_attempt and do_hedge
+                           and not meta["hedged"])
+            first_attempt = False
+            if hedged_here:
+                result = self._attempt_hedged(url, order, idx, path,
+                                              body, headers, remaining,
+                                              meta)
+            else:
+                code, payload = self._attempt(url, path, body, headers,
+                                              remaining())
+                result = (code, payload, url)
+            if result is None:
+                continue
+            won = settle(*result)
+            if won is not None:
+                return won[0], won[1], won[2], meta
+            last = result
+        if meta["deadline_miss"]:
+            with self._lock:
+                self.deadline_misses += 1
+        if codes and all(c == 429 for c in codes):
+            # every replica answered "shedding": not a dead fleet —
+            # surface the smallest admission backoff hint it computed
+            meta["all_shed"] = True
+            with self._lock:
+                self.all_shed += 1
+            payload = dict(last[1]) if isinstance(last[1], dict) else {}
+            payload["all_shed"] = True
+            if hints:
+                payload["retry_after_s"] = min(hints)
+            last = (last[0], payload, last[2])
+        if hints:
+            meta["retry_after_s"] = min(hints)
+        return last[0], last[1], last[2], meta
+
+    # --------------------------------------------------- one attempt
+    def _attempt(self, url: str, path: str, body: bytes,
+                 headers: Optional[Dict[str, str]],
+                 remaining_s: Optional[float]) -> Tuple[int, Any]:
+        """One synchronous attempt: capped by the remaining deadline,
+        deadline header stamped, breaker + latency recorded."""
+        timeout = self.timeout_s
+        hdrs = dict(headers or {})
+        if remaining_s is not None:
+            timeout = max(min(timeout, remaining_s), 1e-3)
+            hdrs[DEADLINE_HEADER] = str(max(int(remaining_s * 1000), 1))
+        t0 = time.monotonic()
+        code, payload = self._post_one(url + path, body, hdrs, timeout)
+        self._note_outcome(url, code, time.monotonic() - t0)
+        return code, payload
+
+    def _attempt_hedged(self, url: str, order: List[str], next_idx: int,
+                        path: str, body: bytes,
+                        headers: Optional[Dict[str, str]],
+                        remaining, meta: Dict[str, Any]
+                        ) -> Optional[Tuple[int, Any, Optional[str]]]:
+        """First attempt with tail hedging: launch ``url``, and if no
+        answer lands within the observed-p99 delay, spend one budget
+        token on a second attempt at the next distinct replica. First
+        answer wins; the loser keeps running on its daemon worker (its
+        outcome still lands in the breaker) but nobody waits for it.
+        Returns the winning ``(code, payload, url)`` or ``None`` when
+        every launched attempt failed retryably."""
+        results: "Queue[Tuple[str, int, Any]]" = Queue()
+
+        def fire(target: str) -> None:
+            rem = remaining()
+            timeout = self.timeout_s
+            hdrs = dict(headers or {})
+            if rem is not None:
+                timeout = max(min(timeout, rem), 1e-3)
+                hdrs[DEADLINE_HEADER] = str(max(int(rem * 1000), 1))
+
+            def worker() -> None:
+                t0 = time.monotonic()
+                code, payload = self._post_one(target + path, body, hdrs,
+                                               timeout)
+                self._note_outcome(target, code,
+                                   time.monotonic() - t0)
+                results.put((target, code, payload))
+
+            obs_threads.spawn(worker, name="router-hedge", daemon=True)
+
+        fire(url)
+        in_flight = 1
+        rem = remaining()
+        delay = self._hedge_delay()
+        if rem is not None:
+            delay = min(delay, max(rem, 0.0))
+        try:
+            target, code, payload = results.get(timeout=delay)
+        except Empty:
+            pass
+        else:
+            # primary answered within the hedge delay — no hedge
+            # needed; the caller settles success vs failover
+            return code, payload, target
+        # primary is slow: buy a hedge at the next distinct,
+        # breaker-admitted replica (if the budget allows)
+        hedge_url = None
+        for j in range(next_idx, next_idx + len(order) - 1):
+            cand = order[j % len(order)]
+            if cand == url:
+                continue
+            if self._breaker(cand).allow():
+                hedge_url = cand
+                break
+        if hedge_url is not None:
+            if self.budget.try_spend():
+                meta["hedged"] = True
+                with self._lock:
+                    self.hedges_fired += 1
+                fire(hedge_url)
+                in_flight += 1
+            else:
+                self._breaker(hedge_url).release()
+        best: Optional[Tuple[int, Any, Optional[str]]] = None
+        while in_flight > 0:
+            rem = remaining()
+            timeout = self.timeout_s + 1.0 if rem is None else max(rem, 0.0)
+            try:
+                target, code, payload = results.get(timeout=timeout)
+            except Empty:
+                meta["deadline_miss"] = True
+                break
+            in_flight -= 1
+            if code not in _RETRYABLE:
+                if meta["hedged"]:
+                    if target != url:
+                        meta["hedge_won"] = True
+                        with self._lock:
+                            self.hedges_won += 1
+                    elif in_flight > 0:
+                        # primary won; refund the abandoned loser
+                        self.budget.give_back()
+                return code, payload, target
+            best = (code, payload, target)
+        return best
+
+    def _note_outcome(self, url: str, code: int, elapsed_s: float) -> None:
+        self._breaker(url).record(code not in _FAILURE_CODES)
+        if code not in _RETRYABLE and code != 0:
+            with self._lock:
+                self._latencies.append(elapsed_s)
 
     def _post_one(self, url: str, body: bytes,
-                  headers: Optional[Dict[str, str]]
-                  ) -> Tuple[int, Any]:
+                  headers: Optional[Dict[str, str]],
+                  timeout: Optional[float] = None) -> Tuple[int, Any]:
         req = urllib.request.Request(url, data=body,
                                      headers=headers or {},
                                      method="POST")
         try:
             with urllib.request.urlopen(
-                    req, timeout=self.timeout_s) as resp:
+                    req, timeout=self.timeout_s
+                    if timeout is None else timeout) as resp:
                 return resp.status, json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             try:

@@ -192,9 +192,15 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
     qps_total = rejects_per_s = queue_depth = 0.0
     requests_total = rejected_total = timed_out_total = 0.0
     completed_total = 0.0
+    standby_replicas = 0
     for s in samples:
         statuses[s.get("status", "unreachable")] = \
             statuses.get(s.get("status", "unreachable"), 0) + 1
+        if s.get("status") == "standby":
+            # warm spares serve nothing — counting them as capacity
+            # would dilute every per-replica signal the policy scales on
+            standby_replicas += 1
+            continue
         m = s.get("metrics") or {}
         qps_total += m.get(_QPS, 0.0)
         rejects_per_s += m.get(_REJECTS_PER_S, 0.0)
@@ -209,6 +215,8 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
     # counter carries a model label next to the fleet-wide sum)
     model_acc: Dict[str, Dict[str, Any]] = {}
     for s in samples:
+        if s.get("status") == "standby":
+            continue
         for model, m in (s.get("by_model") or {}).items():
             acc = model_acc.setdefault(model, {
                 "qps_total": 0.0, "rejects_per_s_total": 0.0,
@@ -240,7 +248,8 @@ def compute_rollup(samples: Sequence[Dict[str, Any]],
     error_rate = errors / max(requests_total + rejected_total, 1.0)
     rollup: Dict[str, Any] = {
         "time": time.time(),
-        "replicas": len(samples),
+        "replicas": len(samples) - standby_replicas,
+        "standby_replicas": standby_replicas,
         "replica_status": statuses,
         "qps_total": round(qps_total, 3),
         "rejects_per_s_total": round(rejects_per_s, 3),

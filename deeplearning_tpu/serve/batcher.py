@@ -162,6 +162,7 @@ class MicroBatcher:
                  admission: Optional[AdmissionController] = None,
                  telemetry: Optional[ServeTelemetry] = None,
                  heartbeat=None,
+                 standby: bool = False,
                  start: bool = True):
         if (engine is None) == (zoo is None):
             raise ValueError("pass exactly one of engine= or zoo=")
@@ -199,6 +200,15 @@ class MicroBatcher:
         # preempt_replica fault targets this replica
         self._draining = threading.Event()
         self.on_preempt = None
+        self.on_crash = None
+        # resilience surface: a standby replica warms fully but refuses
+        # traffic (healthz "standby") until promote(); brownout steps
+        # per model degrade one hot tenant without touching the rest
+        self._standby = threading.Event()
+        if standby:
+            self._standby.set()
+        self._brownout: Dict[str, int] = {}     # model -> ladder step
+        self._bo_count: Dict[str, int] = {}     # model -> submit ordinal
         self._thread: Optional[threading.Thread] = None
         if start:
             self.start()
@@ -265,6 +275,44 @@ class MicroBatcher:
         return (self._draining.is_set() and not self._busy
                 and self.queue_depth == 0)
 
+    # ------------------------------------------------ standby/brownout
+    @property
+    def standby(self) -> bool:
+        return self._standby.is_set()
+
+    def promote(self) -> bool:
+        """Flip a warm standby into rotation: healthz goes "standby" →
+        "ready" on the next probe and submits are accepted immediately.
+        The engine warmed at construction, so promotion costs a flag
+        flip, not an AOT pass. True when this call did the flip."""
+        if self._standby.is_set():
+            self._standby.clear()
+            flight.record("serve_promote", dispatched=self.dispatched)
+            return True
+        return False
+
+    def set_brownout(self, model: str, step: int) -> int:
+        """Set one tenant's degrade-ladder step (0 = full service).
+        Step >= 1: the lane dispatches largest-bucket-only (max
+        throughput posture). Step >= 3: additionally shed a fixed
+        fraction of that lane's submits (deterministic 1-in-4, reason
+        "brownout"). Step 2's int8-residency move belongs to the zoo —
+        the serve CLI applies it when it owns one. Returns the step
+        actually stored (clamped to [0, 3])."""
+        step = max(0, min(int(step), 3))
+        with self._cv:
+            if step:
+                self._brownout[model] = step
+            else:
+                self._brownout.pop(model, None)
+                self._bo_count.pop(model, None)
+        flight.record("serve_brownout", model=model, step=step)
+        return step
+
+    def brownout_step(self, model: str) -> int:
+        with self._cv:
+            return self._brownout.get(model, 0)
+
     # -------------------------------------------------------- lanes
     def _lane(self, model: Optional[str]) -> _Lane:
         if self._default_lane is not None:
@@ -318,11 +366,31 @@ class MicroBatcher:
             raise ValueError(f"request image shape {image.shape} != "
                              f"({size}, {size}, 3); resize client-side")
         try:
+            if self._standby.is_set():
+                # a standby is warm but OUT of rotation — a request
+                # reaching it is a routing error, not load to absorb
+                raise Rejected(len(lane.q), 0.0, model=lane.model,
+                               reason="standby")
             if self._draining.is_set():
                 # a draining replica refuses new work outright — no
                 # retry_after hint would help; the caller must reroute
                 raise Rejected(len(lane.q), 0.0, model=lane.model,
                                reason="draining")
+            if faults.consume("e503", "submit", self.dispatched):
+                # seeded chaos: one injected 503 — exercises router
+                # failover and the per-replica breaker for real
+                raise Rejected(len(lane.q), 0.0, model=lane.model,
+                               reason="injected")
+            if self.brownout_step(lane.model) >= 3:
+                n = 0
+                with self._cv:
+                    n = self._bo_count.get(lane.model, 0) + 1
+                    self._bo_count[lane.model] = n
+                if n % 4 == 0:
+                    raise Rejected(
+                        len(lane.q),
+                        lane.admission.retry_after_s(len(lane.q)),
+                        model=lane.model, reason="brownout")
             if self.zoo is not None:
                 # warm fast-path: dict lookup. Cold: kicks a background
                 # hot-load (may LRU-evict; raises Rejected on pressure)
@@ -452,6 +520,10 @@ class MicroBatcher:
         if cb is not None and faults.consume(
                 "preempt_replica", "step", self.dispatched):
             cb()
+        cb = self.on_crash
+        if cb is not None and faults.consume(
+                "crash_replica", "step", self.dispatched):
+            cb()
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
@@ -477,9 +549,17 @@ class MicroBatcher:
     def _dispatch_one(self, lane: _Lane, engine, batch: list) -> None:
         t0 = time.perf_counter()
         depth = len(lane.q)
-        shed = lane.admission.overloaded(depth)
+        # brownout step >= 1 pins the lane to its max-throughput
+        # posture (largest bucket) even before admission sheds
+        shed = (lane.admission.overloaded(depth)
+                or self.brownout_step(lane.model) >= 1)
         bucket = (engine.buckets[-1] if shed
                   else engine.bucket_for(len(batch)))
+        lat_ms = faults.consume_arg("latency", "step", self.dispatched)
+        if lat_ms:
+            # seeded chaos: injected tail latency — the stimulus the
+            # router's hedging policy exists to absorb
+            time.sleep(lat_ms / 1e3)
         if self.zoo is not None:
             self.zoo.mark_dispatch(lane.model, +1)
         try:

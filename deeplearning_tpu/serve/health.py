@@ -70,21 +70,27 @@ def health(engine, batcher=None,
     bucket is compiled; 503 "degraded" while admission sheds; 503
     "draining" while the batcher refuses new work but still flushes its
     lanes (the controller's drain-and-requeue window — routers must
-    stop sending, in-flight clients still get answers); 503 "wedged"
-    (highest precedence) when ``wedge`` reports a frozen dispatch
-    stream. Pure host reads — never compiles, never syncs the device."""
+    stop sending, in-flight clients still get answers); 503 "standby"
+    while the batcher is a fully-warmed spare awaiting promotion
+    (unroutable, but one ``/admin/promote`` flip from "ready"); 503
+    "wedged" (highest precedence) when ``wedge`` reports a frozen
+    dispatch stream. Pure host reads — never compiles, never syncs the
+    device."""
     warm = engine.compile_count >= len(engine.buckets)
     depth = batcher.queue_depth if batcher is not None else 0
     shed = (batcher.admission.overloaded(depth)
             if batcher is not None else False)
     wedged = wedge is not None and wedge.verdict() == "wedged"
     draining = bool(getattr(batcher, "draining", False))
+    standby = bool(getattr(batcher, "standby", False))
     status = "wedged" if wedged else (
         "draining" if draining else (
-            "ready" if warm and not shed else (
-                "warming" if not warm else "degraded")))
+            "standby" if standby else (
+                "ready" if warm and not shed else (
+                    "warming" if not warm else "degraded"))))
     payload: Dict[str, Any] = {
         "status": status,
+        "standby": standby,
         "engine_warm": warm,
         "queue_depth": depth,
         "shed": shed,
@@ -137,12 +143,15 @@ def zoo_health(zoo, batcher=None,
         models[alias] = entry
     wedged = wedge is not None and wedge.verdict() == "wedged"
     draining = bool(getattr(batcher, "draining", False))
+    standby = bool(getattr(batcher, "standby", False))
     status = "wedged" if wedged else (
         "draining" if draining else (
-            "warming" if any_loading else (
-                "degraded" if any_shed else "ready")))
+            "standby" if standby else (
+                "warming" if any_loading else (
+                    "degraded" if any_shed else "ready"))))
     payload: Dict[str, Any] = {
         "status": status,
+        "standby": standby,
         "zoo": {k: zs[k] for k in ("registered", "resident", "loads",
                                    "evictions", "rejected_loads",
                                    "alert_frac")},

@@ -320,6 +320,22 @@ class ModelZoo:
                       bytes=self._resident_bytes.get(alias, 0))
         return True
 
+    def demote_residency(self, alias: str) -> bool:
+        """Brownout step 2: re-pin ``alias`` to block-scaled int8
+        residency. Flips the spec's ``weight_quant`` and evicts the
+        fp32-resident engine so the next request hot-reloads it ~4x
+        denser; a no-op (False) when the tenant is already int8 or not
+        registered. Best-effort — a load in flight just means the
+        eviction lands on a later call."""
+        with self._lock:
+            spec = self._specs.get(alias)
+            if spec is None or spec.weight_quant == "int8":
+                return False
+            spec.weight_quant = "int8"
+            self._evict_locked(alias)
+        flight.record("zoo_demote", model=alias, weight_quant="int8")
+        return True
+
     def _lru_victim(self, exclude: str) -> Optional[str]:
         candidates = [a for a, st in self._state.items()
                       if st == "warm" and a != exclude

@@ -80,6 +80,53 @@ class TestConcurrencyRules:
         """
         assert "DLT200" not in rules_of(clint(src))
 
+    def test_dlt200_catches_router_refresh_race(self):
+        """The ISSUE 15 satellite bug, in miniature: the router's
+        health refresh rebuilt ``self._urls`` with no lock while its
+        background poller wrote the same attribute — DLT200 must flag
+        the unlocked public write side."""
+        src = """
+            import threading
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._urls = []
+                def _poll(self):
+                    self._urls = ["http://a"]
+                def start(self):
+                    t = threading.Thread(target=self._poll,
+                                         daemon=True)
+                    t.start()
+                    t.join()
+                def refresh(self, urls):
+                    self._urls = list(urls)
+        """
+        assert "DLT200" in rules_of(clint(src))
+
+    def test_dlt200_clean_router_refresh_fixed(self):
+        """The shipped fix: probe outside the lock, write the new set
+        back UNDER the lock on every side — no finding."""
+        src = """
+            import threading
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._urls = []
+                def _poll(self):
+                    with self._lock:
+                        self._urls = ["http://a"]
+                def start(self):
+                    t = threading.Thread(target=self._poll,
+                                         daemon=True)
+                    t.start()
+                    t.join()
+                def refresh(self, urls):
+                    probed = list(urls)
+                    with self._lock:
+                        self._urls = probed
+        """
+        assert "DLT200" not in rules_of(clint(src))
+
     def test_dlt201_inconsistent_lock_order(self):
         src = """
             import threading

@@ -1,0 +1,656 @@
+"""Resilient fleet data plane (ISSUE 15): retry budgets, per-replica
+circuit breakers, tail hedging, end-to-end deadlines, seeded chaos
+schedules, standby promotion, tenant brownout — and the acceptance
+choreography: a chaos soak over a controller-run CPU serve fleet
+(3 replicas + 1 warm standby) where zero requests are silently lost,
+breakers open and re-close, a wedge is healed by promoting the standby,
+and p99 recovers after the schedule drains.
+
+The retry-storm test pins the ISSUE 15 bound directly: with every
+replica answering 503 there are no budget deposits, so total attempts
+observed BY THE SERVERS stay <= (1 + fraction) x offered + burst.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from deeplearning_tpu.elastic import faults
+from deeplearning_tpu.fleet import (FleetPolicy, FleetRouter,
+                                    CONTROLLER_FLIGHT_FILE)
+from deeplearning_tpu.fleet.resilience import CircuitBreaker, RetryBudget
+from deeplearning_tpu.obs.fleet import discover_endpoints
+
+
+def _wait(cond, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ----------------------------------------------------------- budget
+class TestRetryBudget:
+    def test_exhaustion_and_counters(self):
+        rb = RetryBudget(fraction=0.5, cap=4.0, initial=2.0)
+        assert rb.try_spend() and rb.try_spend()
+        assert not rb.try_spend()            # empty: refused, counted
+        snap = rb.snapshot()
+        assert snap["spent"] == 2 and snap["exhausted"] == 1
+        assert snap["tokens"] == 0.0
+
+    def test_successes_deposit_fraction(self):
+        rb = RetryBudget(fraction=0.5, cap=4.0, initial=0.0)
+        assert not rb.try_spend()            # cold + no successes
+        rb.note_success()
+        assert not rb.try_spend()            # 0.5 < 1 token
+        rb.note_success()
+        assert rb.try_spend()                # 1.0 -> spendable
+        assert rb.snapshot()["successes"] == 2
+
+    def test_deposits_clamped_to_cap(self):
+        rb = RetryBudget(fraction=1.0, cap=2.0, initial=0.0)
+        for _ in range(5):
+            rb.note_success()
+        assert rb.tokens() == 2.0
+        assert rb.try_spend() and rb.try_spend() and not rb.try_spend()
+
+    def test_give_back_refunds_abandoned_hedge(self):
+        rb = RetryBudget(fraction=0.2, cap=4.0, initial=1.0)
+        assert rb.try_spend()
+        rb.give_back()
+        assert rb.try_spend()                # refunded token spendable
+        assert rb.snapshot()["refunded"] == 1
+
+    def test_fraction_bounds(self):
+        with pytest.raises(ValueError):
+            RetryBudget(fraction=1.5)
+
+
+# ---------------------------------------------------------- breaker
+class TestCircuitBreaker:
+    def _cb(self, **kw):
+        clock = [0.0]
+        kw.setdefault("window", 8)
+        kw.setdefault("failure_threshold", 0.5)
+        kw.setdefault("min_samples", 2)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return CircuitBreaker(clock=lambda: clock[0], **kw), clock
+
+    def test_full_transition_walk(self):
+        cb, clock = self._cb()
+        assert cb.state == cb.CLOSED and cb.allow()
+        cb.record(False)
+        assert cb.state == cb.CLOSED         # below min_samples
+        cb.record(False)
+        assert cb.state == cb.OPEN           # 2/2 failures >= 0.5
+        assert not cb.allow() and cb.blocking()
+        clock[0] = 6.0                       # past the cooldown
+        assert not cb.blocking()
+        assert cb.allow()                    # the single half-open probe
+        assert cb.state == cb.HALF_OPEN
+        assert not cb.allow()                # second probe refused
+        cb.record(False)                     # probe failed -> re-open
+        assert cb.state == cb.OPEN and not cb.allow()
+        clock[0] = 12.0                      # fresh cooldown re-armed
+        assert cb.allow()
+        cb.record(True)                      # probe ok -> closed, cleared
+        snap = cb.snapshot()
+        assert cb.state == cb.CLOSED
+        assert snap["opens"] == 1 and snap["closes"] == 1
+        assert snap["samples"] == 0          # window cleared on close
+
+    def test_below_threshold_stays_closed(self):
+        cb, _ = self._cb(min_samples=4)
+        for ok in (True, True, True, False):
+            cb.record(ok)
+        assert cb.state == cb.CLOSED and cb.allow()
+
+    def test_release_frees_unused_probe_slot(self):
+        cb, clock = self._cb()
+        cb.record(False)
+        cb.record(False)
+        clock[0] = 6.0
+        assert cb.allow()                    # probe slot consumed
+        cb.release()                         # attempt never launched
+        assert cb.allow()                    # slot available again
+        cb.record(True)
+        assert cb.state == cb.CLOSED
+
+
+# ------------------------------------------------------------ chaos
+class TestChaosSchedule:
+    SPEC = "7:e503*3@0-50;latency:40*2@10-60;wedge:1*1@20-80"
+
+    def test_same_seed_byte_identical(self):
+        a = faults.chaos_schedule(self.SPEC)
+        b = faults.chaos_schedule(self.SPEC)
+        assert a and a == b                  # replayable chaos
+        assert len(a.split(";")) == 6        # 3 + 2 + 1 expanded specs
+        assert faults.chaos_schedule("8" + self.SPEC[1:]) != a
+
+    def test_expands_to_regular_grammar(self):
+        specs = faults.parse_faults(faults.chaos_schedule(self.SPEC))
+        assert len(specs) == 6
+        by_kind = {}
+        for s in specs:
+            by_kind.setdefault(s.kind, []).append(s)
+        assert len(by_kind["e503"]) == 3
+        assert all(s.site == "submit" for s in by_kind["e503"])
+        assert [s.arg for s in by_kind["latency"]] == [40.0, 40.0]
+        (wedge,) = by_kind["wedge_replica"]
+        assert wedge.replica == 1 and 20 <= wedge.at_step <= 80
+
+    def test_malformed_compiles_to_empty(self):
+        for bad in ("noseed", "x:e503", "7:", "7:badkind*2@0-5",
+                    "7:e503*0@0-5", "7:e503*2@9-3", "7:wedge*1@0-5",
+                    "7:e503:9*1@0-5"):
+            assert faults.chaos_schedule(bad) == ""
+
+    def test_defaults_count_one_step_zero(self):
+        assert faults.chaos_schedule("3:preempt:2") == \
+            "preempt_replica:2@step:0"
+
+    def test_active_faults_merges_chaos(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "sigterm@step:5")
+        monkeypatch.setenv(faults.CHAOS_VAR, "7:e503*2@1-3")
+        faults.reset()
+        try:
+            kinds = sorted(s.kind for s in faults.active_faults())
+            assert kinds == ["e503", "e503", "sigterm"]
+        finally:
+            faults.reset()
+
+    def test_consume_arg_fires_once(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "latency:25@step:3")
+        monkeypatch.delenv(faults.CHAOS_VAR, raising=False)
+        faults.reset()
+        try:
+            assert faults.consume_arg("latency", "step", 2) is None
+            assert faults.consume_arg("latency", "step", 3) == 25.0
+            assert faults.consume_arg("latency", "step", 4) is None
+        finally:
+            faults.reset()
+
+
+# ------------------------------------------------- brownout ladder
+class TestBrownoutLadder:
+    def test_hysteresis_climbs_and_descends(self):
+        p = FleetPolicy(min_replicas=1, max_replicas=2,
+                        brownout_breach_polls=2, brownout_clear_polls=2)
+        seq = [p.brownout_observe("m", True) for _ in range(4)]
+        assert seq == [None, 1, None, 2]     # step only on transitions
+        assert p.brownout_steps() == {"m": 2}
+        seq = [p.brownout_observe("m", False) for _ in range(5)]
+        assert seq == [None, 1, None, 0, None]
+        assert p.brownout_steps() == {}
+        snap = p.snapshot()
+        assert snap["brownout_breach_polls"] == 2
+        assert snap["brownout_steps"] == {}
+
+    def test_capped_at_max_step(self):
+        p = FleetPolicy(min_replicas=1, max_replicas=2,
+                        brownout_breach_polls=1, brownout_max_step=2)
+        assert [p.brownout_observe("m", True) for _ in range(4)] == \
+            [1, 2, None, None]
+
+
+# --------------------------------------------------- router layer
+class TestRouterResilience:
+    @staticmethod
+    def _state(**kw):
+        st = {"status": "ready", "post_code": 200, "sleep_s": 0.0,
+              "retry_after_s": 0.5, "hits": 0, "deadlines": [],
+              "lock": threading.Lock()}
+        st.update(kw)
+        return st
+
+    @staticmethod
+    def _mini_server(state):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                status = state["status"]
+                self._send(200 if status == "ready" else 503,
+                           {"status": status})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                with state["lock"]:
+                    state["hits"] += 1
+                    if self.headers.get("X-Deadline-Ms"):
+                        state["deadlines"].append(
+                            int(self.headers["X-Deadline-Ms"]))
+                if state["sleep_s"]:
+                    time.sleep(state["sleep_s"])
+                code = state["post_code"]
+                if code == 200:
+                    self._send(200, {"ok": True})
+                elif code == 429:
+                    self._send(429, {"error": "shedding",
+                                     "retry_after_s":
+                                         state["retry_after_s"]})
+                else:
+                    self._send(code, {"error": "injected"})
+
+            def log_message(self, *args):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def test_retry_storm_bounded_by_budget(self):
+        """ISSUE 15 acceptance: every replica 503s -> total attempts the
+        SERVERS observe stay <= (1 + fraction) x offered + the seed
+        burst (no deposits ever land, so the bucket only drains)."""
+        a = self._state(post_code=503)
+        b = self._state(post_code=503)
+        srv_a, url_a = self._mini_server(a)
+        srv_b, url_b = self._mini_server(b)
+        try:
+            fraction, initial, offered = 0.2, 2.0, 40
+            router = FleetRouter(
+                [url_a, url_b], health_ttl_s=60.0, timeout_s=5.0,
+                hedge=False,
+                budget=RetryBudget(fraction=fraction, cap=10.0,
+                                   initial=initial),
+                # breakers disabled: this test isolates the budget bound
+                breaker_factory=lambda: CircuitBreaker(
+                    failure_threshold=1.1, min_samples=10**6))
+            for _ in range(offered):
+                code, _payload, _url, meta = router.post_ex(
+                    "/predict", b"x")
+                assert code == 503 and not meta["no_route"]
+            attempts = a["hits"] + b["hits"]
+            assert attempts <= (1 + fraction) * offered + initial
+            assert attempts >= offered       # first try is always free
+            stats = router.resilience_stats()
+            assert stats["budget"]["exhausted"] >= 1
+            assert stats["budget"]["successes"] == 0
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    def test_all_shed_surfaces_min_retry_after_hint(self):
+        a = self._state(post_code=429, retry_after_s=0.75)
+        b = self._state(post_code=429, retry_after_s=0.25)
+        srv_a, url_a = self._mini_server(a)
+        srv_b, url_b = self._mini_server(b)
+        try:
+            router = FleetRouter([url_a, url_b], health_ttl_s=60.0,
+                                 timeout_s=5.0, hedge=False)
+            code, payload, _url, meta = router.post_ex("/predict", b"x")
+            assert code == 429
+            assert payload["all_shed"] and meta["all_shed"]
+            assert payload["retry_after_s"] == 0.25   # the SMALLEST hint
+            assert meta["retry_after_s"] == 0.25
+            assert router.all_shed == 1
+            # shedding is load, not failure: breakers stay closed
+            assert router.resilience_stats()["breaker_opens"] == 0
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    def test_hedge_wins_and_abandons_slow_primary(self):
+        a = self._state(sleep_s=1.5)         # injected tail latency
+        b = self._state()
+        srv_a, url_a = self._mini_server(a)
+        srv_b, url_b = self._mini_server(b)
+        try:
+            router = FleetRouter(
+                [url_a, url_b], health_ttl_s=60.0, timeout_s=5.0,
+                hedge=True, hedge_delay_s=0.05,
+                budget=RetryBudget(fraction=0.2, cap=4.0, initial=2.0))
+            t0 = time.monotonic()
+            code, payload, url, meta = router.post_ex("/predict", b"x")
+            elapsed = time.monotonic() - t0
+            assert (code, url) == (200, url_b) and payload == {"ok": True}
+            assert meta["hedged"] and meta["hedge_won"]
+            # the loser is ABANDONED: nobody waited out its 1.5 s
+            assert elapsed < 1.0, elapsed
+            stats = router.resilience_stats()
+            assert stats["hedges_fired"] == 1 and stats["hedges_won"] == 1
+            # the hedge replaced a would-be slow answer: token stays spent
+            assert stats["budget"]["spent"] == 1
+            assert stats["budget"]["refunded"] == 0
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    def test_primary_win_refunds_hedge_token(self):
+        a = self._state(sleep_s=0.3)
+        b = self._state(sleep_s=2.0)
+        srv_a, url_a = self._mini_server(a)
+        srv_b, url_b = self._mini_server(b)
+        try:
+            router = FleetRouter(
+                [url_a, url_b], health_ttl_s=60.0, timeout_s=5.0,
+                hedge=True, hedge_delay_s=0.05,
+                budget=RetryBudget(fraction=0.2, cap=4.0, initial=2.0))
+            t0 = time.monotonic()
+            code, _payload, url, meta = router.post_ex("/predict", b"x")
+            elapsed = time.monotonic() - t0
+            assert (code, url) == (200, url_a)
+            assert meta["hedged"] and not meta["hedge_won"]
+            assert elapsed < 1.5, elapsed    # hedge loser not awaited
+            snap = router.resilience_stats()["budget"]
+            assert snap["spent"] == 1 and snap["refunded"] == 1
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    def test_deadline_header_stamped_and_miss_counted(self):
+        fast = self._state()
+        srv, url = self._mini_server(fast)
+        try:
+            router = FleetRouter([url], health_ttl_s=60.0,
+                                 timeout_s=5.0, hedge=False)
+            code, _p, _u, meta = router.post_ex("/predict", b"x",
+                                                deadline_s=5.0)
+            assert code == 200 and not meta["deadline_miss"]
+            assert fast["deadlines"] and 0 < fast["deadlines"][0] <= 5000
+        finally:
+            srv.shutdown()
+        slow_a = self._state(sleep_s=0.8)
+        slow_b = self._state(sleep_s=0.8)
+        srv_a, url_a = self._mini_server(slow_a)
+        srv_b, url_b = self._mini_server(slow_b)
+        try:
+            router = FleetRouter([url_a, url_b], health_ttl_s=60.0,
+                                 timeout_s=5.0, hedge=False)
+            code, _p, _u, meta = router.post_ex("/predict", b"x",
+                                                deadline_s=0.2)
+            # first attempt times out AT the deadline; the would-be
+            # retry at B is refused because no budget remains
+            assert code == 0 and meta["deadline_miss"]
+            assert router.deadline_misses == 1
+            # the attempt carried only the REMAINING budget
+            assert slow_a["deadlines"] and slow_a["deadlines"][0] <= 200
+            assert not slow_b["hits"]        # never launched past it
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    def test_breaker_removes_then_readmits_replica(self):
+        a = self._state(post_code=503)
+        b = self._state()
+        srv_a, url_a = self._mini_server(a)
+        srv_b, url_b = self._mini_server(b)
+        try:
+            router = FleetRouter(
+                [url_a, url_b], health_ttl_s=0.0, timeout_s=5.0,
+                hedge=False,
+                budget=RetryBudget(fraction=0.5, cap=10.0, initial=10.0),
+                breaker_factory=lambda: CircuitBreaker(
+                    window=4, failure_threshold=0.5, min_samples=2,
+                    reset_timeout_s=0.3))
+            # healthz says "ready" on A throughout: only the BREAKER can
+            # take it out of rotation between refreshes
+            for _ in range(4):
+                code, _p, _u, _m = router.post_ex("/predict", b"x")
+                assert code == 200            # failover covers the 503s
+            assert url_a not in router.routable()
+            stats = router.resilience_stats()
+            assert stats["breaker_opens"] >= 1
+            assert stats["breakers"][url_a]["state"] == "open"
+
+            a["post_code"] = 200              # replica recovers
+            time.sleep(0.35)                  # past the reset timeout
+
+            def reclosed():
+                router.post_ex("/predict", b"x")
+                return router.resilience_stats()["breaker_closes"] >= 1
+
+            _wait(reclosed, timeout=10.0, interval=0.05,
+                  msg="half-open probe re-closes the breaker")
+            assert url_a in router.routable()
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+
+# ------------------------------------------- serve-side primitives
+class TestServeStandbyBrownout:
+    def test_standby_refuses_then_promote_flips(self):
+        from deeplearning_tpu.serve import (InferenceEngine,
+                                            MicroBatcher, Rejected)
+        from deeplearning_tpu.serve.health import health
+        eng = InferenceEngine("mnist_fcn", num_classes=10,
+                              image_size=28, batch_buckets=(1, 4))
+        img = np.zeros((28, 28, 3), np.float32)
+        with MicroBatcher(eng, max_wait_ms=2.0, standby=True) as mb:
+            code, payload = health(eng, mb)
+            assert code == 503 and payload["status"] == "standby"
+            assert payload["standby"]
+            with pytest.raises(Rejected) as ei:
+                mb.submit(img)
+            assert ei.value.reason == "standby"
+            assert mb.promote()               # the flip IS the promotion
+            assert not mb.promote()           # idempotent: already live
+            code, payload = health(eng, mb)
+            assert code == 200 and payload["status"] == "ready"
+            h = mb.submit(img)
+            assert np.asarray(h.result(timeout=60.0)).shape == (10,)
+
+    def test_brownout_step3_sheds_deterministic_fraction(self):
+        from deeplearning_tpu.serve import (InferenceEngine,
+                                            MicroBatcher, Rejected)
+        eng = InferenceEngine("mnist_fcn", num_classes=10,
+                              image_size=28, batch_buckets=(1, 4))
+        img = np.zeros((28, 28, 3), np.float32)
+        with MicroBatcher(eng, max_wait_ms=2.0) as mb:
+            assert mb.set_brownout("mnist_fcn", 5) == 3   # clamped
+            assert mb.brownout_step("mnist_fcn") == 3
+            outcomes = []
+            handles = []
+            for _ in range(8):
+                try:
+                    handles.append(mb.submit(img))
+                    outcomes.append("ok")
+                except Rejected as e:
+                    assert e.reason == "brownout"
+                    outcomes.append("shed")
+            # deterministic 1-in-4: exactly the 4th and 8th submits shed
+            assert outcomes == ["ok"] * 3 + ["shed"] + ["ok"] * 3 + \
+                ["shed"]
+            for h in handles:
+                np.asarray(h.result(timeout=60.0))
+            assert mb.set_brownout("mnist_fcn", 0) == 0   # full service
+            assert mb.brownout_step("mnist_fcn") == 0
+            np.asarray(mb.submit(img).result(timeout=60.0))
+
+
+# ------------------------------------------------- chaos soak CPU e2e
+@pytest.mark.e2e
+class TestChaosSoakE2E:
+    def test_seeded_chaos_soak_with_standby_promotion(self, tmp_path):
+        """The ISSUE 15 acceptance soak: a controller-run 3-replica CPU
+        serve fleet plus ONE warm standby, under seeded chaos
+        (``DLTPU_CHAOS``: injected 503s, injected tail latency, and one
+        wedge on replica 1). Asserts: zero silently-lost requests
+        (submitted == completed + rejected + timed_out + no_route),
+        breakers open AND re-close, the wedge is healed by PROMOTING
+        the standby (fleet_promote, reason "wedged") with the spare
+        replenished behind it, p99 recovers once the schedule drains,
+        obs_report renders the resilience section, and SIGTERM
+        classifies the whole fleet to exit 0."""
+        import loadgen
+
+        wd = str(tmp_path / "fleet")
+        env = dict(os.environ)
+        env.pop("DLTPU_HEARTBEAT", None)
+        env.pop("DLTPU_FAULTS", None)
+        # same seed -> byte-identical schedule (chaos, but replayable).
+        # The six 503s share a tight step window so they land as a
+        # BURST per replica — two failures inside the breaker window
+        # are guaranteed, so open -> probe -> re-close is deterministic.
+        # The preempt is scheduled well after the wedge so the single
+        # warm spare provably goes to the wedge heal first
+        env["DLTPU_CHAOS"] = ("42:e503*6@8-12;latency:150*2@5-25;"
+                              "wedge:1*1@12-18;preempt:2*1@45-55")
+        cmd = [sys.executable, os.path.join(ROOT, "tools",
+                                            "supervise.py"),
+               "--controller", "--replicas", "3",
+               "--min-replicas", "3", "--max-replicas", "5",
+               "--standby", "1",
+               "--run-id", "chaos-test", "--workdir", wd,
+               "--max-restarts", "2",
+               "--wedge-deadline", "600", "--startup-deadline", "600",
+               "--kill-grace", "5",
+               "--scale-interval", "0.5", "--drain-deadline", "3",
+               # autoscaling thresholds parked out of reach: the only
+               # actuations are the chaos-driven heal + promotion
+               "--p99-budget", "100000", "--queue-high", "100000",
+               "--error-budget", "2.0", "--breach-polls", "3",
+               "--idle-polls", "100000", "--cooldown", "2",
+               "--",
+               sys.executable, os.path.join(ROOT, "tools", "serve.py"),
+               "--model", "mnist_fcn", "--num-classes", "10",
+               "--size", "28", "--buckets", "1,4", "--max-wait-ms", "2",
+               "--http", "0", "--wedge-deadline-s", "2"]
+        log = open(os.path.join(str(tmp_path), "supervise.log"), "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        flight_path = os.path.join(wd, CONTROLLER_FLIGHT_FILE)
+
+        def controller_events():
+            try:
+                with open(flight_path) as f:
+                    return json.load(f).get("events", [])
+            except (OSError, ValueError):
+                return []
+
+        def events_of(kind):
+            return [e for e in controller_events() if e["kind"] == kind]
+
+        try:
+            deadline = time.time() + 240.0
+            while time.time() < deadline:
+                if len(discover_endpoints(wd, live_only=True)) >= 3:
+                    break
+                assert proc.poll() is None, \
+                    f"supervise died rc={proc.returncode}; see {log.name}"
+                time.sleep(0.25)
+            endpoints = discover_endpoints(wd, live_only=True)
+            assert len(endpoints) >= 3, endpoints
+
+            router = FleetRouter(
+                endpoints,
+                refresh_fn=lambda: discover_endpoints(
+                    wd, live_only=True),
+                timeout_s=3.0,
+                breaker_factory=lambda: CircuitBreaker(
+                    window=8, failure_threshold=0.25, min_samples=2,
+                    reset_timeout_s=1.0))
+            images = loadgen.make_images(16, 28)
+
+            # the warm spare exists before any fault needs it, and the
+            # router keeps it OUT of rotation (standby is unroutable)
+            _wait(lambda: events_of("fleet_standby"), timeout=60.0,
+                  interval=0.5, msg="initial standby replenish")
+            _wait(lambda: "standby" in router.statuses().values(),
+                  timeout=120.0, interval=0.5,
+                  msg=f"standby advertised: {router.statuses()}")
+            assert all(router.statuses()[u] != "standby"
+                       for u in router.routable())
+
+            # phase 1: open-loop load while the seeded schedule fires
+            res1 = loadgen.run_open_loop_http(
+                router, images, rate_hz=24.0, duration_s=20.0,
+                timeout_s=4.0)
+            assert res1["submitted"] > 0
+            # ZERO silently-lost requests: every submit is accounted
+            assert res1["submitted"] == (
+                res1["completed"] + res1["rejected"]
+                + res1["timed_out"] + res1["no_route"]), res1
+            assert res1["completed"] >= 0.5 * res1["submitted"], res1
+            rows1 = res1["timeline"]
+            assert rows1 and all(k in rows1[0] for k in
+                                 ("retries", "hedged", "deadline_miss",
+                                  "no_route"))
+            pre_rows = [r["p99_ms"] for r in rows1
+                        if r["t"] <= 2 and r["completed"] > 0]
+            pre_band_ms = max(min(pre_rows) if pre_rows else 100.0,
+                              50.0)
+
+            # the wedge is healed by PROMOTION, not a cold spawn, and
+            # the promotion itself is a healthz flip (fast)
+            _wait(lambda: any(e.get("reason") == "wedged"
+                              for e in events_of("fleet_promote")),
+                  timeout=120.0, interval=0.5,
+                  msg=f"fleet_promote(wedged) in {controller_events()}")
+            promote = next(e for e in events_of("fleet_promote")
+                           if e.get("reason") == "wedged")
+            assert promote["seconds"] < 10.0, promote
+            # the pool replenishes behind the promotion: a NEW spare
+            _wait(lambda: len(events_of("fleet_standby")) >= 2,
+                  timeout=120.0, interval=0.5,
+                  msg="standby pool replenished after promotion")
+            # the scheduled preemption (exit 75) is handled as capacity
+            _wait(lambda: events_of("preempt_capacity"), timeout=120.0,
+                  interval=0.5, msg="preempt_capacity event")
+            pre = events_of("preempt_capacity")[0]
+            assert pre["replica"] == 2 and pre["verdict"] == "replace"
+
+            # phase 2: schedule drained -> the healed fleet recovers
+            _wait(lambda: len(router.routable()) >= 3, timeout=180.0,
+                  interval=1.0, msg="3 routable replicas after heal")
+            res2 = loadgen.run_open_loop_http(
+                router, images, rate_hz=24.0, duration_s=8.0,
+                timeout_s=4.0)
+            assert res2["completed"] >= 0.9 * res2["submitted"], res2
+            assert res2["timed_out"] == 0, res2
+            assert res2["p99_ms"] <= max(20.0 * pre_band_ms, 1000.0), \
+                (res2["p99_ms"], pre_band_ms)
+
+            # breakers earned their keep across the soak: the injected
+            # 503 bursts / wedge timeouts opened at least one, and the
+            # half-open probe re-closed it once the replica recovered
+            stats = router.resilience_stats()
+            assert stats["breaker_opens"] >= 1, stats
+            assert stats["breaker_closes"] >= 1, stats
+
+            # obs_report folds the chaos run into a resilience section
+            with open(os.path.join(wd, "loadgen.json"), "w") as f:
+                json.dump(res1, f)
+            view = subprocess.run(
+                [sys.executable,
+                 os.path.join(ROOT, "tools", "obs_report.py"), wd],
+                capture_output=True, text=True, timeout=120)
+            assert view.returncode == 0, view.stderr
+            assert "resilience:" in view.stdout, view.stdout
+            assert "promote reasons: wedged" in view.stdout, view.stdout
+
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            log.close()
+        tail = open(log.name).read()
+        assert "fleet done run_id=chaos-test" in tail, tail[-2000:]
+        assert "exit=0" in tail, tail[-2000:]

@@ -28,11 +28,13 @@ rows; ``--mix`` runs append one row per tenant.
 
 Fleet HTTP mode (``--mode open --fleet-urls`` / ``--fleet-dir``):
 arrivals POST ``/predict`` to a replica fleet through a
-``FleetRouter`` (round-robin, drains skipped, failover on 503) —
-the drive side of the controller choreography test. Open-loop
-records carry a per-second ``timeline`` (QPS split + p99) so
-recovery-after-fault can be asserted against the trajectory, not
-the run-wide aggregate.
+``FleetRouter`` (round-robin, drains skipped, failover on 503,
+deadline propagation, budgeted retries + tail hedging, per-replica
+circuit breakers — README "Resilience policy"). Open-loop records
+carry a per-second ``timeline`` (QPS split + p99 + retry/hedge/
+deadline-miss counts) so recovery-after-fault can be asserted against
+the trajectory, not the run-wide aggregate, and embed the router's
+``resilience_stats()``.
 """
 
 from __future__ import annotations
@@ -84,13 +86,15 @@ class Timeline:
         self._lock = threading.Lock()
         self._buckets: dict = {}
 
-    def note(self, key: str, lat=None) -> None:
+    _KEYS = ("submitted", "completed", "rejected", "timed_out",
+             "no_route", "retries", "hedged", "deadline_miss")
+
+    def note(self, key: str, lat=None, n: int = 1) -> None:
         sec = int(time.perf_counter() - self.t0)
         with self._lock:
             row = self._buckets.setdefault(
-                sec, {"submitted": 0, "completed": 0, "rejected": 0,
-                      "timed_out": 0, "lats": []})
-            row[key] += 1
+                sec, {k: 0 for k in self._KEYS} | {"lats": []})
+            row[key] += n
             if lat is not None:
                 row["lats"].append(lat)
 
@@ -99,12 +103,10 @@ class Timeline:
             out = []
             for sec in sorted(self._buckets):
                 row = self._buckets[sec]
-                out.append({
-                    "t": sec, "submitted": row["submitted"],
-                    "completed": row["completed"],
-                    "rejected": row["rejected"],
-                    "timed_out": row["timed_out"],
-                    "p99_ms": _percentiles_ms(row["lats"])["p99_ms"]})
+                out.append(
+                    {"t": sec}
+                    | {k: row[k] for k in self._KEYS}
+                    | {"p99_ms": _percentiles_ms(row["lats"])["p99_ms"]})
             return out
 
 
@@ -357,8 +359,13 @@ def run_open_loop_http(router, images, rate_hz: float,
     the drain-and-requeue choreography. Latency is arrival→response
     (loadgen queueing included: a stalled fleet shows up as p99, not as
     a quietly slower arrival process). 2xx counts as completed, a
-    429/503 that survived failover as rejected, connection errors and
-    no-route as timed out."""
+    429/503 that survived failover as rejected (an all-shed fleet's
+    smallest retry-after hint is surfaced), an empty rotation as
+    no_route, connection errors and deadline misses as timed out. Each
+    request carries the remaining deadline (``X-Deadline-Ms``); the
+    per-second timeline records the router's retry/hedge/deadline-miss
+    counts next to the QPS split, and the record embeds
+    ``router.resilience_stats()``."""
     import io
     import queue as _queue
 
@@ -366,7 +373,9 @@ def run_open_loop_http(router, images, rate_hz: float,
     jobs: "_queue.Queue" = _queue.Queue()
     lock = threading.Lock()
     state = {"submitted": 0, "completed": 0, "rejected": 0,
-             "timed_out": 0}
+             "timed_out": 0, "no_route": 0, "retries": 0, "hedged": 0,
+             "deadline_miss": 0}
+    hints = []
     lats = []
 
     def sender():
@@ -375,15 +384,34 @@ def run_open_loop_http(router, images, rate_hz: float,
             if item is None:
                 return
             t0, body = item
-            code, _payload, _url = router.post(
+            code, payload, _url, meta = router.post_ex(
                 "/predict", body,
-                headers={"Content-Type": "application/octet-stream"})
+                headers={"Content-Type": "application/octet-stream"},
+                deadline_s=timeout_s)
             lat = time.perf_counter() - t0
+            retries = int(meta.get("retries", 0))
+            with lock:
+                state["retries"] += retries
+                state["hedged"] += int(bool(meta.get("hedged")))
+                state["deadline_miss"] += int(
+                    bool(meta.get("deadline_miss")))
+                if meta.get("retry_after_s") is not None:
+                    hints.append(meta["retry_after_s"])
+            if retries:
+                timeline.note("retries", n=retries)
+            if meta.get("hedged"):
+                timeline.note("hedged")
+            if meta.get("deadline_miss"):
+                timeline.note("deadline_miss")
             if 200 <= code < 300:
                 with lock:
                     state["completed"] += 1
                     lats.append(lat)
                 timeline.note("completed", lat)
+            elif meta.get("no_route"):
+                with lock:
+                    state["no_route"] += 1
+                timeline.note("no_route")
             elif code in (429, 503):
                 with lock:
                     state["rejected"] += 1
@@ -420,11 +448,15 @@ def run_open_loop_http(router, images, rate_hz: float,
         jobs.put(None)
     for t in pool:
         t.join(timeout=timeout_s)
-    return {"mode": "open_http", "rate_hz": rate_hz, **state,
-            "req_per_s": round(state["completed"] / duration_s, 1),
-            **_percentiles_ms(lats),
-            "failovers": router.failovers, "no_route": router.no_route,
-            "timeline": timeline.rows()}
+    rec = {"mode": "open_http", "rate_hz": rate_hz, **state,
+           "req_per_s": round(state["completed"] / duration_s, 1),
+           **_percentiles_ms(lats),
+           "failovers": router.failovers,
+           "resilience": router.resilience_stats(),
+           "timeline": timeline.rows()}
+    if hints:
+        rec["retry_after_hint_s"] = min(hints)
+    return rec
 
 
 def append_serve_row(results_path: str, rec: dict, **extra) -> None:

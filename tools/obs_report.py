@@ -114,6 +114,69 @@ def controller_summary(doc: Optional[Dict[str, Any]]
     return out
 
 
+def load_loadgen(run_dir: str) -> Optional[Dict[str, Any]]:
+    """A routed loadgen record (``loadgen.json``) dropped into the run
+    dir by the chaos soak / choreography tests — carries the router's
+    ``resilience_stats()`` and the per-second timeline."""
+    path = os.path.join(run_dir, "loadgen.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def resilience_summary(ctl_doc: Optional[Dict[str, Any]],
+                       loadgen: Optional[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Data-plane resilience posture: standby promotions and brownout
+    transitions from the controller's decision log, plus the router's
+    retry/hedge/breaker/deadline accounting when a routed loadgen
+    record is present. Pure; None when neither source says anything."""
+    out: Dict[str, Any] = {}
+    ev = (ctl_doc or {}).get("events", [])
+    promotes = [e for e in ev if e.get("kind") == "fleet_promote"]
+    if promotes:
+        out["promotions"] = len(promotes)
+        out["promote_reasons"] = [str(e.get("reason"))
+                                  for e in promotes]
+        secs = [e.get("seconds") for e in promotes
+                if isinstance(e.get("seconds"), (int, float))]
+        if secs:
+            out["promote_max_s"] = round(max(secs), 4)
+    standbys = [e for e in ev if e.get("kind") == "fleet_standby"]
+    if standbys:
+        out["standby_spawns"] = len(standbys)
+    brownouts = [e for e in ev if e.get("kind") == "fleet_brownout"]
+    if brownouts:
+        out["brownout_transitions"] = len(brownouts)
+        steps: Dict[str, int] = {}
+        for e in brownouts:         # last transition wins per tenant
+            if e.get("model") is not None:
+                steps[str(e["model"])] = int(e.get("step", 0))
+        out["brownout_last_steps"] = steps
+    if loadgen:
+        res = loadgen.get("resilience") or {}
+        for k in ("retries", "hedged", "deadline_miss", "no_route"):
+            if loadgen.get(k) is not None:
+                out[k] = loadgen[k]
+        for k in ("hedges_fired", "hedges_won", "breaker_opens",
+                  "breaker_closes", "breaker_skips", "all_shed"):
+            if res.get(k) is not None:
+                out[k] = res[k]
+        budget = res.get("budget") or {}
+        if budget:
+            out["budget"] = {k: budget[k] for k in
+                             ("tokens", "spent", "refunded", "exhausted")
+                             if k in budget}
+        if loadgen.get("retry_after_hint_s") is not None:
+            out["retry_after_hint_s"] = loadgen["retry_after_hint_s"]
+    return out or None
+
+
 def load_registry(run_dir: str) -> Optional[Dict[str, Any]]:
     """The metrics-registry snapshot a Trainer dumps at obs shutdown
     (``metrics_registry.json``) — the same state /metrics exposed live."""
@@ -374,9 +437,14 @@ def summarize(run_dir: str) -> Dict[str, Any]:
     if fleet:
         out["fleet"] = fleet
 
-    controller = controller_summary(load_controller(run_dir))
+    ctl_doc = load_controller(run_dir)
+    controller = controller_summary(ctl_doc)
     if controller:
         out["controller"] = controller
+
+    resilience = resilience_summary(ctl_doc, load_loadgen(run_dir))
+    if resilience:
+        out["resilience"] = resilience
 
     zoo = zoo_summary(registry_raw, fleet_rows, flight)
     if zoo:
@@ -683,6 +751,39 @@ def render(summary: Dict[str, Any]) -> str:
         if ct.get("preempt_verdicts"):
             lines.append("  preempt verdicts: "
                          + ", ".join(ct["preempt_verdicts"]))
+    rs = summary.get("resilience")
+    if rs:
+        lines.append("")
+        bits = []
+        if rs.get("promotions"):
+            bit = f"promotions={rs['promotions']}"
+            if rs.get("promote_max_s") is not None:
+                bit += f" (max {rs['promote_max_s'] * 1e3:.0f}ms)"
+            bits.append(bit)
+        if rs.get("standby_spawns"):
+            bits.append(f"standby_spawns={rs['standby_spawns']}")
+        if rs.get("brownout_transitions"):
+            bits.append(
+                f"brownouts={rs['brownout_transitions']}")
+        for k in ("retries", "hedged", "deadline_miss", "no_route",
+                  "hedges_won", "breaker_opens", "breaker_closes"):
+            if rs.get(k) is not None:
+                bits.append(f"{k}={rs[k]}")
+        lines.append("resilience: " + (" ".join(bits) or "(quiet)"))
+        if rs.get("promote_reasons"):
+            lines.append("  promote reasons: "
+                         + ", ".join(rs["promote_reasons"]))
+        if rs.get("brownout_last_steps"):
+            lines.append("  brownout steps: " + ", ".join(
+                f"{m}={s}" for m, s in
+                sorted(rs["brownout_last_steps"].items())))
+        budget = rs.get("budget")
+        if budget:
+            lines.append(
+                f"  retry budget: tokens={budget.get('tokens')} "
+                f"spent={budget.get('spent')} "
+                f"refunded={budget.get('refunded')} "
+                f"exhausted={budget.get('exhausted')}")
     z = summary.get("zoo")
     if z:
         lines.append("")
@@ -857,12 +958,47 @@ def _check() -> int:
                    reason="p99_breach", live=2)
         ctl.record("fleet_scale", direction="down", replica=3,
                    reason="sustained_idle", live=3)
+        # resilience actuations (PR 15): a warm-standby spawn + promote,
+        # one tenant brownout transition
+        ctl.record("fleet_standby", replica=4, target=1)
+        ctl.record("fleet_promote", replica=4,
+                   url="http://127.0.0.1:9004", reason="wedged",
+                   seconds=0.012)
+        ctl.record("fleet_brownout", model="alpha", step=1,
+                   replicas=2, breach=True)
         ctl.record("controller_stop", ticks=9, scale_ups=1,
-                   scale_downs=1, drains=1, requeues=1, preemptions=1)
+                   scale_downs=1, drains=1, requeues=1, preemptions=1,
+                   promotions=1, brownouts=1)
         assert ctl.configure(
             os.path.join(run_dir, "flightrec_controller.json"),
             {"policy": {"min_replicas": 2, "max_replicas": 4}}
         ).dump("controller_stop", include_hbm=False)
+
+        # routed loadgen record with the router's resilience stats —
+        # built through the REAL RetryBudget/CircuitBreaker snapshots
+        # so the section exercises the actual schema
+        from deeplearning_tpu.fleet.resilience import (CircuitBreaker,
+                                                       RetryBudget)
+        rb = RetryBudget(fraction=0.2, cap=10.0, initial=2.0)
+        for _ in range(5):
+            rb.note_success()
+        assert rb.try_spend()
+        cb = CircuitBreaker(min_samples=2, failure_threshold=0.5,
+                            reset_timeout_s=0.0)
+        cb.record(False)
+        cb.record(False)          # trips open
+        assert cb.allow()         # past cooldown: half-open probe
+        cb.record(True)           # probe ok: closed again
+        with open(os.path.join(run_dir, "loadgen.json"), "w") as f:
+            json.dump({"mode": "open_http", "retries": 3, "hedged": 2,
+                       "deadline_miss": 1, "no_route": 0,
+                       "retry_after_hint_s": 0.25,
+                       "resilience": {
+                           "hedges_fired": 2, "hedges_won": 1,
+                           "breaker_opens": cb.snapshot()["opens"],
+                           "breaker_closes": cb.snapshot()["closes"],
+                           "breaker_skips": 4, "all_shed": 1,
+                           "budget": rb.snapshot()}}, f)
 
         # metrics-registry snapshot through the real registry API (the
         # file a Trainer dumps at obs shutdown)
@@ -986,6 +1122,28 @@ def _check() -> int:
         for token in ("controller: scale_ups=1", "requeues=1",
                       "scale reasons: p99_breach, sustained_idle",
                       "preempt verdicts: replace"):
+            assert token in report, report
+        # resilience posture: controller promote/brownout events joined
+        # with the routed loadgen's retry/hedge/breaker accounting
+        rs = summary["resilience"]
+        assert rs["promotions"] == 1, rs
+        assert rs["promote_reasons"] == ["wedged"], rs
+        assert rs["promote_max_s"] == 0.012, rs
+        assert rs["standby_spawns"] == 1, rs
+        assert rs["brownout_transitions"] == 1, rs
+        assert rs["brownout_last_steps"] == {"alpha": 1}, rs
+        assert rs["retries"] == 3 and rs["hedged"] == 2, rs
+        assert rs["deadline_miss"] == 1, rs
+        assert rs["hedges_won"] == 1, rs
+        assert rs["breaker_opens"] == 1, rs
+        assert rs["breaker_closes"] == 1, rs
+        assert rs["retry_after_hint_s"] == 0.25, rs
+        assert rs["budget"]["spent"] == 1, rs
+        for token in ("resilience: promotions=1 (max 12ms)",
+                      "standby_spawns=1", "brownouts=1",
+                      "promote reasons: wedged",
+                      "brownout steps: alpha=1",
+                      "retry budget: tokens="):
             assert token in report, report
         # zoo posture section: registry labels + fleet per-model fold
         zz = summary["zoo"]

@@ -175,6 +175,9 @@ def make_metrics_collector(batcher):
         reg.gauge("dltpu_serve_queue_depth",
                   "live micro-batch queue depth").set(
             float(batcher.queue_depth))
+        reg.gauge("dltpu_serve_standby",
+                  "1 while a warm spare out of rotation").set(
+            1.0 if batcher.standby else 0.0)
         if batcher.zoo is None:
             for key, val in batcher.engine.stats().items():
                 if isinstance(val, (int, float)) \
@@ -200,6 +203,10 @@ def make_metrics_collector(batcher):
                 float(batcher.lane_depth(alias)))
             reg.gauge("dltpu_zoo_model_warm", "1 while servable",
                       labels=labels).set(1.0 if row["warm"] else 0.0)
+            reg.gauge("dltpu_serve_brownout_step",
+                      "tenant degrade-ladder step (0 = full service)",
+                      labels=labels).set(
+                float(batcher.brownout_step(alias)))
             reg.gauge("dltpu_zoo_model_bytes", "resident weight bytes",
                       labels=labels).set(float(row["bytes"]))
             if "trace_count" in row:
@@ -228,6 +235,7 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     the per-tenant state table, and ``POST /admin/load/<model>`` /
     ``POST /admin/evict/<model>`` drive residency by hand."""
     import io
+    from concurrent.futures import TimeoutError as FutureTimeout
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from deeplearning_tpu.obs import metrics as obs_metrics
@@ -255,11 +263,16 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
             self.wfile.write(body)
 
         def _rejected(self, r):
+            # admission backpressure answers 429 ("slow down, retry
+            # here"); a standby or chaos-injected refusal answers 503
+            # ("wrong replica / failed attempt") so the router's
+            # breaker classification sees the difference
+            code = 503 if r.reason in ("standby", "injected") else 429
             body = json.dumps({
                 "error": "rejected", "reason": r.reason,
                 "model": r.model, "depth": r.depth,
                 "retry_after_s": round(r.retry_after_s, 3)}).encode()
-            self.send_response(429)
+            self.send_response(code)
             self.send_header("Retry-After", f"{r.retry_after_s:.3f}")
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -304,18 +317,30 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
 
         def _predict(self, alias):
             n = int(self.headers.get("Content-Length", 0))
+            # end-to-end deadline: a router stamping X-Deadline-Ms is
+            # spending ONE budget across retries/hedges — map it onto
+            # the admission deadline so queue time counts against it
+            req_timeout = timeout_s
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr:
+                try:
+                    req_timeout = min(timeout_s,
+                                      max(int(hdr), 1) / 1e3)
+                except ValueError:
+                    pass
             try:
                 arr = np.load(io.BytesIO(self.rfile.read(n)),
                               allow_pickle=False)
                 images = np.asarray(arr, np.float32)
                 if images.ndim == 3:
                     images = images[None]
-                handles = [batcher.submit(img, model=alias)
+                handles = [batcher.submit(img, timeout_s=req_timeout,
+                                          model=alias)
                            for img in images]
-                rows = [h.result(timeout=timeout_s) for h in handles]
+                rows = [h.result(timeout=req_timeout) for h in handles]
             except Rejected as r:
                 return self._rejected(r)
-            except DeadlineExceeded:
+            except (DeadlineExceeded, FutureTimeout):
                 return self._json(504, {"error": "deadline_exceeded"})
             except KeyError as e:
                 return self._json(404, {"error": repr(e)})
@@ -348,6 +373,28 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                                         "drained": bool(batcher.drained),
                                         "queue_depth":
                                             batcher.queue_depth})
+            elif parts == ["admin", "promote"]:
+                # fleet controller verb: warm standby -> rotation. The
+                # engine AOT'd at startup, so this is a flag flip —
+                # healthz answers "ready" on the very next probe
+                return self._json(200, {"promoted": batcher.promote(),
+                                        "standby": batcher.standby})
+            elif (len(parts) == 4 and parts[0] == "admin"
+                    and parts[1] == "brownout"):
+                # fleet controller verb: one tenant's degrade-ladder
+                # step (0 restores). Step 2+ additionally demotes the
+                # tenant to int8 residency when a zoo owns the weights
+                alias, step_s = parts[2], parts[3]
+                try:
+                    step = int(step_s)
+                except ValueError:
+                    return self._json(400,
+                                      {"error": "step must be an int"})
+                applied = batcher.set_brownout(alias, step)
+                out = {"model": alias, "step": applied}
+                if zoo is not None and applied >= 2:
+                    out["demoted"] = zoo.demote_residency(alias)
+                return self._json(200, out)
             elif (zoo is not None and len(parts) == 3
                     and parts[0] == "admin"
                     and parts[1] in ("load", "evict")):
@@ -367,7 +414,9 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
                     out["evicted"] = evicted
                 return self._json(200, out)
             return self._json(404, {
-                "error": "POST /predict[/<model>], /admin/drain or "
+                "error": "POST /predict[/<model>], /admin/drain, "
+                         "/admin/promote, "
+                         "/admin/brownout/<model>/<step> or "
                          "/admin/{load,evict}/<model>"})
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -375,7 +424,8 @@ def serve_http(batcher, task: str, size: int, names, topk: int,
     # advertise the scrape endpoint when a supervisor asked for it
     obs_metrics.write_endpoint(url, role="serve")
     endpoints = ["/predict", "/stats", "/healthz", "/metrics",
-                 "/metrics.json", "/admin/drain"]
+                 "/metrics.json", "/admin/drain", "/admin/promote",
+                 "/admin/brownout/<model>/<step>"]
     if zoo is not None:
         endpoints[:1] = ["/predict/<model>", "/models",
                          "/admin/load/<model>", "/admin/evict/<model>"]
@@ -529,7 +579,9 @@ def main(argv=None) -> int:
                           max_wait_ms=args.max_wait_ms,
                           max_queue=args.max_queue,
                           default_timeout_s=args.timeout_s,
-                          heartbeat=beat) as batcher:
+                          heartbeat=beat,
+                          standby=os.environ.get("DLTPU_STANDBY")
+                          == "1") as batcher:
             if args.http is not None:
                 server = serve_http(batcher, task, size,
                                     names, args.topk, args.timeout_s,
@@ -571,6 +623,16 @@ def main(argv=None) -> int:
                                       name="serve-preempt-drain",
                                       daemon=True)
                 batcher.on_preempt = _preempted
+
+                # chaos crash (crash_replica:<i>): a hard, instant
+                # death — no drain, no cleanup; the supervisor must
+                # classify a crash and in-flight clients see the
+                # connection drop, exactly like a segfaulted replica
+                def _crashed():
+                    obs_flight.record("serve_crash",
+                                      dispatched=batcher.dispatched)
+                    os._exit(1)
+                batcher.on_crash = _crashed
                 try:
                     server.serve_forever()
                 except KeyboardInterrupt:

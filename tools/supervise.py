@@ -32,7 +32,10 @@ replica's ``/metrics``+``/healthz`` on a cadence, scales between
 error-burn breach vs sustained idle, drains-and-requeues wedged
 serving replicas (``POST /admin/drain`` → deadline → supervisor
 restart directive), and treats a replica's exit 75 as a capacity
-event (immediate replace-or-shed, no backoff). Decisions are recorded
+event (immediate replace-or-shed, no backoff). ``--standby N`` keeps N
+fully-warmed unroutable spares; losing capacity promotes one (a healthz
+flip) instead of paying a cold spawn, and per-tenant SLO breach climbs
+a brownout ladder pushed to every replica. Decisions are recorded
 to ``<workdir>/flightrec_controller.json``.
 """
 
@@ -86,7 +89,7 @@ def run_controller(args, command) -> int:
     max_replicas = (args.max_replicas if args.max_replicas is not None
                     else max(min_replicas * 2, args.replicas, 2))
 
-    def factory(i: int):
+    def factory(i: int, standby: bool = False):
         from deeplearning_tpu.elastic.supervisor import SupervisorConfig
         return SupervisorConfig(
             command,
@@ -100,6 +103,7 @@ def run_controller(args, command) -> int:
             kill_grace_s=args.kill_grace,
             run_id=run_id,
             replica=i,
+            env=({"DLTPU_STANDBY": "1"} if standby else None),
         )
 
     replica_set = ReplicaSet(factory)
@@ -114,13 +118,17 @@ def run_controller(args, command) -> int:
         slo=SLOPolicy(p99_budget_ms=args.p99_budget,
                       error_rate_budget=args.error_budget),
         interval_s=args.scale_interval,
-        drain_deadline_s=args.drain_deadline)
+        drain_deadline_s=args.drain_deadline,
+        standby_target=args.standby)
 
     print(f"[supervise] controller run_id={run_id} "
-          f"replicas={args.replicas} bounds=[{min_replicas},"
+          f"replicas={args.replicas} standby={args.standby} "
+          f"bounds=[{min_replicas},"
           f"{max_replicas}] workdir={workdir}", file=sys.stderr)
     for _ in range(args.replicas):
         replica_set.spawn()
+    # warm spares are the controller's job: its first tick replenishes
+    # to --standby and tracks the indices from birth
     controller.start()
 
     stop_evt = threading.Event()
@@ -146,7 +154,9 @@ def run_controller(args, command) -> int:
     print(f"[supervise] controller done ticks={s['ticks']} "
           f"scale_ups={s['scale_ups']} scale_downs={s['scale_downs']} "
           f"drains={s['drains']} requeues={s['requeues']} "
-          f"preemptions={s['preemptions']}", file=sys.stderr)
+          f"preemptions={s['preemptions']} "
+          f"promotions={s['promotions']} brownouts={s['brownouts']}",
+          file=sys.stderr)
     return _classified_exit(replica_set.outcomes(),
                             replica_set.results(), run_id)
 
@@ -211,6 +221,11 @@ def main(argv=None) -> int:
                              "scale-down")
     parser.add_argument("--cooldown", type=float, default=30.0,
                         help="seconds between scale actions")
+    parser.add_argument("--standby", type=int, default=0,
+                        help="warm spares the controller keeps fully "
+                             "warmed but unroutable (DLTPU_STANDBY=1); "
+                             "wedges/preemptions/scale-ups promote one "
+                             "instead of cold-spawning")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command (prefix with --)")
     args = parser.parse_args(argv)
